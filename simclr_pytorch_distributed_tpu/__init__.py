@@ -1,0 +1,21 @@
+"""simclr_pytorch_distributed_tpu — a TPU-native (JAX/XLA/pjit) framework with the
+capabilities of Dyfine/SimCLR_pytorch_distributed.
+
+The reference is a 2-GPU PyTorch DDP SimCLR/SupCon pretrainer (NCCL all-gather of
+projection features + SyncBN) plus a single-GPU linear probe. This package rebuilds
+it TPU-first:
+
+- single-program SPMD over a ``jax.sharding.Mesh`` (GSPMD) instead of
+  ``torch.distributed.launch`` + DDP (reference ``main_supcon.py:359-364``),
+- cross-replica batch norm falls out of sharded-batch statistics instead of
+  ``SyncBatchNorm.convert_sync_batchnorm`` (reference ``main_supcon.py:223-224``),
+- the NT-Xent global-negatives gather is a differentiable logical-global matmul
+  (XLA inserts the collectives) instead of ``torch.distributed.all_gather`` plus
+  the local-tensor re-insertion trick (reference ``main_supcon.py:268-279``),
+- augmentations run jitted on device instead of 8 PIL DataLoader workers
+  (reference ``main_supcon.py:200-207``).
+"""
+
+__version__ = "0.1.0"
+
+from simclr_pytorch_distributed_tpu.ops.losses import supcon_loss  # noqa: F401

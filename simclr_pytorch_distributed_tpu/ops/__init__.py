@@ -1,0 +1,3 @@
+from simclr_pytorch_distributed_tpu.ops.losses import supcon_loss  # noqa: F401
+from simclr_pytorch_distributed_tpu.ops import schedules  # noqa: F401
+from simclr_pytorch_distributed_tpu.ops import metrics  # noqa: F401

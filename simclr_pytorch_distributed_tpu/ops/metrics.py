@@ -1,0 +1,49 @@
+"""Metrics and meters.
+
+``topk_accuracy`` matches the reference ``accuracy()`` (``util.py:37-51``): percent
+of targets found in the top-k predictions, returned per requested k.
+``AverageMeter`` mirrors ``util.py:19-34`` for host-side wall-clock/metric
+averaging in the epoch drivers.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def topk_accuracy(
+    output: jax.Array, target: jax.Array, topk: Sequence[int] = (1,)
+) -> Tuple[jax.Array, ...]:
+    """Top-k accuracy in percent, one scalar per k (reference ``util.py:37-51``)."""
+    maxk = max(topk)
+    batch_size = target.shape[0]
+    # [maxk, batch] ranked predictions.
+    _, pred = jax.lax.top_k(output, maxk)
+    correct = pred.T == target[None, :]
+    res = []
+    for k in topk:
+        correct_k = jnp.sum(correct[:k].astype(jnp.float32))
+        res.append(correct_k * (100.0 / batch_size))
+    return tuple(res)
+
+
+class AverageMeter:
+    """Running value/average meter (reference ``util.py:19-34``)."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.val = 0.0
+        self.avg = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val: float, n: int = 1) -> None:
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / self.count

@@ -1,0 +1,88 @@
+"""Learning-rate schedules with the reference's exact semantics.
+
+The reference drives LR two ways that interact (``util.py:54-76``):
+
+- per-EPOCH base schedule ``adjust_learning_rate``: cosine with
+  ``eta_min = lr * lr_decay_rate**3`` (``util.py:57-59``) or step decay counting
+  boundaries already passed (``util.py:61-63``); epoch is 1-based;
+- per-ITERATION linear warmup ``warmup_learning_rate`` that OVERRIDES the epoch
+  schedule during the first ``warm_epochs`` epochs (``util.py:69-76``), ramping
+  ``warmup_from -> warmup_to`` where ``warmup_to`` is the closed-form cosine value
+  at the end of warmup (``main_supcon.py:124-131``).
+
+Here the whole thing is a single pure function of the global step so it can live
+inside the jitted train step (no Python mutation of optimizer state). A factory
+returns an optax-compatible ``schedule(step) -> lr``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+
+def cosine_lr(lr: float, lr_decay_rate: float, epoch, total_epochs: int):
+    """Reference cosine-per-epoch schedule (``util.py:56-59``). `epoch` is 1-based."""
+    eta_min = lr * (lr_decay_rate**3)
+    return eta_min + (lr - eta_min) * (
+        1.0 + jnp.cos(jnp.pi * epoch / total_epochs)
+    ) / 2.0
+
+
+def step_lr(lr: float, lr_decay_rate: float, lr_decay_epochs: Sequence[int], epoch):
+    """Reference step-decay schedule (``util.py:61-63``)."""
+    boundaries = jnp.asarray(lr_decay_epochs)
+    steps = jnp.sum(epoch > boundaries)
+    return lr * (lr_decay_rate ** steps)
+
+
+def warmup_to_value(
+    lr: float, lr_decay_rate: float, warm_epochs: int, total_epochs: int, cosine: bool
+) -> float:
+    """Closed-form warmup target (``main_supcon.py:124-131``)."""
+    if cosine:
+        eta_min = lr * (lr_decay_rate**3)
+        return eta_min + (lr - eta_min) * (
+            1 + math.cos(math.pi * warm_epochs / total_epochs)
+        ) / 2
+    return lr
+
+
+def make_lr_schedule(
+    *,
+    learning_rate: float,
+    epochs: int,
+    steps_per_epoch: int,
+    cosine: bool = False,
+    lr_decay_rate: float = 0.1,
+    lr_decay_epochs: Sequence[int] = (700, 800, 900),
+    warm: bool = False,
+    warm_epochs: int = 10,
+    warmup_from: float = 0.01,
+) -> Callable:
+    """Build ``lr(step)`` reproducing the reference's epoch+warmup composition.
+
+    ``step`` is the 0-based global iteration; ``epoch = step // steps_per_epoch + 1``
+    and ``batch_id = step % steps_per_epoch`` recover the reference's loop variables
+    (``main_supcon.py:382`` epoch loop, ``:263`` per-iter warmup call).
+    """
+    lr_decay_epochs = tuple(lr_decay_epochs)
+    warmup_to = warmup_to_value(learning_rate, lr_decay_rate, warm_epochs, epochs, cosine)
+
+    def schedule(step):
+        step = jnp.asarray(step)
+        epoch = step // steps_per_epoch + 1
+        if cosine:
+            base = cosine_lr(learning_rate, lr_decay_rate, epoch, epochs)
+        else:
+            base = step_lr(learning_rate, lr_decay_rate, lr_decay_epochs, epoch)
+        if not warm:
+            return base
+        # Reference warmup: p = (batch_id + (epoch-1)*B) / (warm_epochs*B) == step/...
+        p = step / (warm_epochs * steps_per_epoch)
+        warm_lr = warmup_from + p * (warmup_to - warmup_from)
+        return jnp.where(epoch <= warm_epochs, warm_lr, base)
+
+    return schedule

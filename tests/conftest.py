@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+The reference has no tests at all (SURVEY.md §4); its distributed semantics were
+only ever exercised on 2 real GPUs. The TPU-native answer is
+``--xla_force_host_platform_device_count=8`` so every sharding/collective test
+runs against a real 8-way mesh on CPU.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep CPU matmuls deterministic-ish and fast on the single-core test host.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The image's sitecustomize (PYTHONPATH=/root/.axon_site) imports jax at
+# interpreter startup with JAX_PLATFORMS=axon baked in, so the env var above is
+# captured too late — override through the live config as well.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,132 @@
+"""Golden-value tests for supcon_loss against an independent numpy oracle.
+
+The oracle below is written straight from the math (per-anchor mean log-likelihood
+of positives under a temperature softmax over non-self pairs, scaled by
+-tau/tau_base), NOT from the reference's tensor program, so agreement is evidence
+of semantic parity rather than shared bugs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu.ops.losses import cross_entropy_loss, supcon_loss
+
+
+def oracle_supcon(features, labels=None, mask=None, temperature=0.07,
+                  base_temperature=0.07, contrast_mode="all"):
+    """Direct per-anchor computation of the SupCon/SimCLR loss."""
+    B, V, D = features.shape
+    # All views, view-major rows.
+    rows = np.concatenate([features[:, v, :] for v in range(V)], axis=0)  # [V*B, D]
+
+    def positives_of(i_sample):
+        if mask is not None:
+            return [j for j in range(B) if mask[i_sample, j]]
+        if labels is not None:
+            return [j for j in range(B) if labels[j] == labels[i_sample]]
+        return [i_sample]
+
+    anchors = range(V * B) if contrast_mode == "all" else range(B)
+    losses = []
+    for a in anchors:
+        a_sample = a % B
+        a_vec = rows[a] if contrast_mode == "all" else features[a, 0]
+        sims = rows @ a_vec / temperature
+        # softmax denominator over every non-self contrast row
+        others = [j for j in range(V * B) if j != a]
+        denom = np.log(np.sum(np.exp(sims[others] - sims[others].max()))) + sims[others].max()
+        pos_samples = positives_of(a_sample)
+        # positive rows: every view of each positive sample, excluding self row
+        pos_rows = [v * B + j for v in range(V) for j in pos_samples if v * B + j != a]
+        mean_logprob = np.mean([sims[p] - denom for p in pos_rows])
+        losses.append(-(temperature / base_temperature) * mean_logprob)
+    return float(np.mean(losses))
+
+
+def normed(rng, B, V, D):
+    x = rng.normal(size=(B, V, D)).astype(np.float32)
+    return x / np.linalg.norm(x, axis=-1, keepdims=True)
+
+
+@pytest.mark.parametrize("temperature", [0.07, 0.5])
+@pytest.mark.parametrize("mode", ["all", "one"])
+def test_simclr_matches_oracle(rng, temperature, mode):
+    f = normed(rng, B=8, V=2, D=16)
+    got = supcon_loss(jnp.asarray(f), temperature=temperature, contrast_mode=mode)
+    want = oracle_supcon(f, temperature=temperature, contrast_mode=mode)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_supcon_labels_matches_oracle(rng):
+    f = normed(rng, B=10, V=2, D=8)
+    labels = rng.integers(0, 3, size=10)
+    got = supcon_loss(jnp.asarray(f), labels=jnp.asarray(labels), temperature=0.1)
+    want = oracle_supcon(f, labels=labels, temperature=0.1)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_explicit_mask_matches_oracle(rng):
+    f = normed(rng, B=6, V=2, D=8)
+    labels = rng.integers(0, 2, size=6)
+    mask = (labels[:, None] == labels[None, :]).astype(np.float32)
+    got = supcon_loss(jnp.asarray(f), mask=jnp.asarray(mask))
+    want = oracle_supcon(f, mask=mask)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_base_temperature_scale(rng):
+    """tau/tau_base multiplier: at tau=0.5, tau_base=0.07 the loss is ~7.14x the
+    tau_base=0.5 value (reference losses.py:90 quirk, part of the recipe)."""
+    f = normed(rng, B=8, V=2, D=16)
+    ratio = supcon_loss(jnp.asarray(f), temperature=0.5) / supcon_loss(
+        jnp.asarray(f), temperature=0.5, base_temperature=0.5
+    )
+    np.testing.assert_allclose(float(ratio), 0.5 / 0.07, rtol=1e-5)
+
+
+def test_more_views(rng):
+    f = normed(rng, B=4, V=3, D=8)
+    got = supcon_loss(jnp.asarray(f))
+    want = oracle_supcon(f)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_labels_and_mask_mutually_exclusive(rng):
+    f = jnp.asarray(normed(rng, 4, 2, 8))
+    with pytest.raises(ValueError):
+        supcon_loss(f, labels=jnp.zeros(4, jnp.int32), mask=jnp.eye(4))
+
+
+def test_rank2_features_rejected():
+    with pytest.raises(ValueError):
+        supcon_loss(jnp.ones((4, 8)))
+
+
+def test_extra_dims_flattened(rng):
+    f = normed(rng, 4, 2, 16)
+    got4d = supcon_loss(jnp.asarray(f.reshape(4, 2, 4, 4)))
+    got3d = supcon_loss(jnp.asarray(f))
+    np.testing.assert_allclose(np.asarray(got4d), np.asarray(got3d), rtol=1e-6)
+
+
+def test_jit_and_grad(rng):
+    f = jnp.asarray(normed(rng, 8, 2, 16))
+    loss_fn = jax.jit(lambda x: supcon_loss(x, temperature=0.5))
+    g = jax.grad(lambda x: supcon_loss(x, temperature=0.5))(f)
+    assert jnp.isfinite(loss_fn(f))
+    assert jnp.all(jnp.isfinite(g))
+    # detached row-max: grads must not flow through the max subtraction; an easy
+    # necessary condition is that loss is invariant to it numerically
+    assert g.shape == f.shape
+
+
+def test_cross_entropy_against_numpy(rng):
+    logits = rng.normal(size=(16, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, size=16)
+    got = cross_entropy_loss(jnp.asarray(logits), jnp.asarray(labels))
+    z = logits - logits.max(axis=1, keepdims=True)
+    logp = z - np.log(np.exp(z).sum(axis=1, keepdims=True))
+    want = -np.mean(logp[np.arange(16), labels])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)

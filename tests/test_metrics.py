@@ -1,0 +1,27 @@
+import jax.numpy as jnp
+import numpy as np
+
+from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter, topk_accuracy
+
+
+def test_topk_accuracy_known_values():
+    logits = jnp.asarray(
+        [
+            [0.1, 0.9, 0.0],  # pred 1
+            [0.8, 0.05, 0.15],  # pred 0, second-best 2
+            [0.2, 0.3, 0.5],  # pred 2, second-best 1
+        ]
+    )
+    target = jnp.asarray([1, 1, 1])
+    acc1, acc2 = topk_accuracy(logits, target, topk=(1, 2))
+    np.testing.assert_allclose(float(acc1), 100.0 / 3, rtol=1e-5)
+    np.testing.assert_allclose(float(acc2), 200.0 / 3, rtol=1e-5)
+
+
+def test_average_meter():
+    m = AverageMeter()
+    m.update(1.0, n=2)
+    m.update(4.0, n=1)
+    assert m.val == 4.0
+    assert m.count == 3
+    np.testing.assert_allclose(m.avg, 2.0)

@@ -1,0 +1,71 @@
+"""Schedule parity tests: closed forms re-derived independently from util.py:54-76
+and main_supcon.py:120-131 semantics."""
+
+import math
+
+import numpy as np
+
+from simclr_pytorch_distributed_tpu.ops.schedules import (
+    cosine_lr,
+    make_lr_schedule,
+    step_lr,
+    warmup_to_value,
+)
+
+
+def test_cosine_endpoints():
+    lr, rate, total = 0.5, 0.1, 200
+    eta_min = lr * rate**3
+    # epoch=0 would give lr exactly; epoch starts at 1 in the reference loop
+    np.testing.assert_allclose(
+        float(cosine_lr(lr, rate, 1, total)),
+        eta_min + (lr - eta_min) * (1 + math.cos(math.pi / total)) / 2,
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(float(cosine_lr(lr, rate, total, total)), eta_min, rtol=1e-6)
+
+
+def test_step_decay_boundaries():
+    lr, rate = 0.1, 0.2
+    bounds = (60, 75, 90)
+    assert float(step_lr(lr, rate, bounds, 60)) == lr  # epoch > bound strictly
+    np.testing.assert_allclose(float(step_lr(lr, rate, bounds, 61)), lr * rate, rtol=1e-6)
+    np.testing.assert_allclose(float(step_lr(lr, rate, bounds, 100)), lr * rate**3, rtol=1e-6)
+
+
+def test_warmup_to_closed_form():
+    lr, rate, warm_epochs, epochs = 0.5, 0.1, 10, 200
+    eta_min = lr * rate**3
+    want = eta_min + (lr - eta_min) * (1 + math.cos(math.pi * warm_epochs / epochs)) / 2
+    np.testing.assert_allclose(warmup_to_value(lr, rate, warm_epochs, epochs, True), want)
+    assert warmup_to_value(lr, rate, warm_epochs, epochs, False) == lr
+
+
+def test_schedule_composition():
+    spe = 50  # steps per epoch
+    sched = make_lr_schedule(
+        learning_rate=0.5, epochs=100, steps_per_epoch=spe, cosine=True,
+        lr_decay_rate=0.1, warm=True, warm_epochs=10, warmup_from=0.01,
+    )
+    warmup_to = warmup_to_value(0.5, 0.1, 10, 100, True)
+    # step 0 == epoch 1 batch 0: p=0 -> warmup_from
+    np.testing.assert_allclose(float(sched(0)), 0.01, rtol=1e-6)
+    # middle of warmup
+    step = 5 * spe  # epoch 6 batch 0 -> p = 0.5
+    np.testing.assert_allclose(
+        float(sched(step)), 0.01 + 0.5 * (warmup_to - 0.01), rtol=1e-6
+    )
+    # first step after warmup -> cosine at epoch 11
+    step = 10 * spe
+    np.testing.assert_allclose(
+        float(sched(step)), float(cosine_lr(0.5, 0.1, 11, 100)), rtol=1e-6
+    )
+
+
+def test_schedule_no_warm_uses_base_everywhere():
+    sched = make_lr_schedule(
+        learning_rate=0.1, epochs=100, steps_per_epoch=10, cosine=False,
+        lr_decay_rate=0.2, lr_decay_epochs=(60, 75, 90), warm=False,
+    )
+    np.testing.assert_allclose(float(sched(0)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(70 * 10)), 0.1 * 0.2, rtol=1e-6)
