@@ -28,7 +28,7 @@ def test_cosine_endpoints():
 def test_step_decay_boundaries():
     lr, rate = 0.1, 0.2
     bounds = (60, 75, 90)
-    assert float(step_lr(lr, rate, bounds, 60)) == lr  # epoch > bound strictly
+    np.testing.assert_allclose(float(step_lr(lr, rate, bounds, 60)), lr, rtol=1e-6)  # epoch > bound strictly
     np.testing.assert_allclose(float(step_lr(lr, rate, bounds, 61)), lr * rate, rtol=1e-6)
     np.testing.assert_allclose(float(step_lr(lr, rate, bounds, 100)), lr * rate**3, rtol=1e-6)
 
