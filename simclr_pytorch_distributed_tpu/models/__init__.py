@@ -1,0 +1,14 @@
+from simclr_pytorch_distributed_tpu.models.resnet import (  # noqa: F401
+    MODEL_DICT,
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+)
+from simclr_pytorch_distributed_tpu.models.heads import (  # noqa: F401
+    LinearClassifier,
+    SupCEResNet,
+    SupConResNet,
+)
+from simclr_pytorch_distributed_tpu.models.norm import CrossReplicaBatchNorm  # noqa: F401

@@ -1,0 +1,124 @@
+"""Task heads over the ResNet encoder (reference resnet_big.py:159-204).
+
+- ``SupConResNet``: encoder + projection head ('mlp' default: dim->dim->ReLU->128,
+  or 'linear'), returning the UNNORMALIZED embedding — L2 normalization happens in
+  the train step after the global gather, matching the reference driver
+  (``main_supcon.py:283``; head defined at ``resnet_big.py:165-172``).
+- ``LinearClassifier``: single linear layer over frozen encoder features
+  (``resnet_big.py:196-204``).
+- ``SupCEResNet``: encoder + linear classifier for the cross-entropy baseline
+  (``resnet_big.py:184-193``; its trainer was lost in the reference fork and is
+  rebuilt in ``train/ce.py``).
+
+Linear layers use torch's default init (uniform ±1/sqrt(fan_in) for both kernel
+and bias) so the published recipe's init statistics carry over.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from simclr_pytorch_distributed_tpu.models.resnet import MODEL_DICT
+
+
+class TorchDense(nn.Module):
+    """nn.Dense with torch nn.Linear's default U(±1/sqrt(fan_in)) init."""
+
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        fan_in = x.shape[-1]
+        bound = 1.0 / (fan_in**0.5)
+
+        def uniform_init(key, shape, dtype=jnp.float32):
+            return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+        kernel = self.param("kernel", uniform_init, (fan_in, self.features))
+        bias = self.param("bias", uniform_init, (self.features,))
+        y = x.astype(self.dtype) @ kernel.astype(self.dtype)
+        return y + bias.astype(self.dtype)
+
+
+class ProjectionHead(nn.Module):
+    """'mlp' (dim_in -> dim_in -> ReLU -> feat_dim) or 'linear' head
+    (reference resnet_big.py:165-172)."""
+
+    head: str = "mlp"
+    dim_in: int = 2048
+    feat_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if self.head == "linear":
+            return TorchDense(self.feat_dim, dtype=self.dtype, name="fc")(x)
+        if self.head == "mlp":
+            h = TorchDense(self.dim_in, dtype=self.dtype, name="fc1")(x)
+            h = nn.relu(h)
+            return TorchDense(self.feat_dim, dtype=self.dtype, name="fc2")(h)
+        raise NotImplementedError(f"head not supported: {self.head}")
+
+
+class SupConResNet(nn.Module):
+    """Backbone + projection head (reference resnet_big.py:159-181)."""
+
+    model_name: str = "resnet50"
+    head: str = "mlp"
+    feat_dim: int = 128
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+    sync_bn: bool = True
+
+    def setup(self):
+        model_fn, dim_in = MODEL_DICT[self.model_name]
+        self.encoder = model_fn(
+            dtype=self.dtype, axis_name=self.axis_name, sync_bn=self.sync_bn
+        )
+        self.proj_head = ProjectionHead(
+            head=self.head, dim_in=dim_in, feat_dim=self.feat_dim, dtype=self.dtype
+        )
+
+    def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
+        return self.proj_head(self.encoder(x, train=train))
+
+    def encode(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        """Encoder features only — the probe's frozen feature extractor
+        (reference main_linear.py:170-172)."""
+        return self.encoder(x, train=train)
+
+
+class SupCEResNet(nn.Module):
+    """Encoder + classifier for supervised CE (reference resnet_big.py:184-193)."""
+
+    model_name: str = "resnet50"
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+    sync_bn: bool = True
+
+    def setup(self):
+        model_fn, _ = MODEL_DICT[self.model_name]
+        self.encoder = model_fn(
+            dtype=self.dtype, axis_name=self.axis_name, sync_bn=self.sync_bn
+        )
+        self.fc = TorchDense(self.num_classes, dtype=jnp.float32)
+
+    def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
+        return self.fc(self.encoder(x, train=train))
+
+
+class LinearClassifier(nn.Module):
+    """Linear probe over precomputed features (reference resnet_big.py:196-204)."""
+
+    model_name: str = "resnet50"
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, features: jax.Array) -> jax.Array:
+        return TorchDense(self.num_classes, name="fc")(features)

@@ -1,0 +1,96 @@
+"""Cross-replica batch normalization, TPU-native.
+
+The reference gets synchronized BN by swapping every BatchNorm2d for
+``torch.nn.SyncBatchNorm`` (``main_supcon.py:223-224``), which all-reduces batch
+statistics across GPUs with a dedicated CUDA kernel. On TPU under GSPMD there is
+no kernel to swap: the train step is ONE logical program over the global batch,
+so computing ``mean(x, axis=(0,1,2))`` on a batch-sharded NHWC array *is*
+synchronized BN — XLA inserts the cross-chip reductions over ICI automatically.
+
+This module therefore implements plain batch statistics plus:
+
+- torch-matching semantics: biased variance for normalization, UNBIASED variance
+  for the running-stat update, running update ``new = (1-m)*old + m*batch`` with
+  ``momentum=0.1``, ``eps=1e-5`` (torch BatchNorm2d defaults used throughout the
+  reference's ``networks/resnet_big.py``);
+- an optional ``axis_name`` for explicit-collective contexts (``shard_map`` /
+  ``pmap``), where stats are combined with ``lax.pmean`` — this is the
+  per-device-program equivalent of SyncBatchNorm and also what a multi-host
+  data-parallel step uses across the ``data`` axis;
+- fp32 statistics regardless of compute dtype (bf16 activations are normalized
+  with fp32 mean/var, matching what mixed-precision SyncBN does).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class CrossReplicaBatchNorm(nn.Module):
+    """BatchNorm over the (logically global) batch for NHWC activations.
+
+    Attributes:
+      momentum: torch-convention running-stat momentum (weight of the NEW batch
+        statistic; torch default 0.1).
+      epsilon: numerical-stability constant (torch default 1e-5).
+      use_running_average: eval mode — normalize with running stats.
+      axis_name: if set, batch statistics are additionally ``lax.pmean``-ed over
+        this mapped axis (shard_map/pmap path). Leave ``None`` under GSPMD jit,
+        where sharded-batch statistics are already global.
+      sync: if False, skip the ``axis_name`` reduction even when provided —
+        reproduces the reference's non-``--syncBN`` per-device BN semantics.
+    """
+
+    momentum: float = 0.1
+    epsilon: float = 1e-5
+    use_running_average: bool = False
+    axis_name: Optional[str] = None
+    sync: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, use_running_average: Optional[bool] = None):
+        use_ra = nn.merge_param(
+            "use_running_average", self.use_running_average, use_running_average
+        )
+        num_features = x.shape[-1]
+        reduce_axes = tuple(range(x.ndim - 1))  # (N, H, W) for NHWC
+
+        scale = self.param("scale", nn.initializers.ones, (num_features,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (num_features,), jnp.float32)
+
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((num_features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((num_features,), jnp.float32)
+        )
+
+        xf = x.astype(jnp.float32)
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            mean = jnp.mean(xf, axis=reduce_axes)
+            mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
+            count = 1
+            for a in reduce_axes:
+                count *= x.shape[a]
+            if self.axis_name is not None and self.sync:
+                mean = jax.lax.pmean(mean, self.axis_name)
+                mean_sq = jax.lax.pmean(mean_sq, self.axis_name)
+                count *= jax.lax.axis_size(self.axis_name)
+            var = mean_sq - jnp.square(mean)  # biased — used for normalization
+
+            if not self.is_initializing():
+                # torch running update: biased mean, UNBIASED variance.
+                unbiased_var = var * (count / max(count - 1, 1))
+                m = self.momentum
+                ra_mean.value = (1.0 - m) * ra_mean.value + m * mean
+                ra_var.value = (1.0 - m) * ra_var.value + m * unbiased_var
+
+        y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon) * scale + bias
+        return y.astype(self.dtype or x.dtype)

@@ -1,0 +1,163 @@
+"""CIFAR-variant ResNet family (18/34/50/101), TPU-native NHWC Flax modules.
+
+Architecture parity with the reference ``networks/resnet_big.py``:
+
+- CIFAR stem: single 3x3 stride-1 conv, NO maxpool (reference ``:75-77``);
+- four stages of widths 64/128/256/512 with strides 1/2/2/2 (``:78-81``);
+- ``BasicBlock`` (expansion 1, ``:7-34``) and ``Bottleneck`` (expansion 4,
+  ``:37-67``) with 1x1-conv+BN projection shortcuts on shape change (``:18-23``);
+- global average pool + flatten (``:82,116-117``) giving 512 (rn18/34) or 2048
+  (rn50/101) features — see ``MODEL_DICT`` (reference ``model_dict :137-142``);
+- Kaiming-normal fan-out conv init, BN gamma=1/beta=0 (``:84-89``); optional
+  ``zero_init_residual`` zeroing the last BN gamma per block (``:94-99``).
+
+Deliberately NOT carried over (dead code in the reference, SURVEY.md §2.1 #11):
+the never-enabled ``is_last``/preact return path, the unused ``layer`` forward
+argument, and ``LinearBatchNorm``.
+
+TPU-first choices: NHWC layout (XLA:TPU's native conv layout), fp32 params with
+an optional bf16 compute ``dtype`` (convs hit the MXU in bf16; BN statistics stay
+fp32 inside ``CrossReplicaBatchNorm``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from simclr_pytorch_distributed_tpu.models.norm import CrossReplicaBatchNorm
+
+# torch nn.init.kaiming_normal_(mode='fan_out', nonlinearity='relu')
+conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class BasicBlock(nn.Module):
+    """3x3 + 3x3 residual block, expansion 1 (reference resnet_big.py:7-34)."""
+
+    planes: int
+    stride: int = 1
+    expansion: int = 1
+    dtype: Any = jnp.float32
+    norm: Callable[..., nn.Module] = CrossReplicaBatchNorm
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        norm = partial(self.norm, use_running_average=not train)
+        conv = partial(
+            nn.Conv, use_bias=False, kernel_init=conv_kernel_init, dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )
+        out = conv(self.planes, (3, 3), strides=(self.stride, self.stride))(x)
+        out = nn.relu(norm(name="bn1")(out))
+        out = conv(self.planes, (3, 3))(out)
+        out = norm(name="bn2")(out)
+
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != self.expansion * self.planes:
+            shortcut = conv(
+                self.expansion * self.planes, (1, 1),
+                strides=(self.stride, self.stride), name="shortcut_conv",
+            )(x)
+            shortcut = norm(name="shortcut_bn")(shortcut)
+        return nn.relu(out + shortcut)
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1 residual block, expansion 4 (reference resnet_big.py:37-67)."""
+
+    planes: int
+    stride: int = 1
+    expansion: int = 4
+    dtype: Any = jnp.float32
+    norm: Callable[..., nn.Module] = CrossReplicaBatchNorm
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        norm = partial(self.norm, use_running_average=not train)
+        conv = partial(
+            nn.Conv, use_bias=False, kernel_init=conv_kernel_init, dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )
+        out = conv(self.planes, (1, 1))(x)
+        out = nn.relu(norm(name="bn1")(out))
+        out = conv(self.planes, (3, 3), strides=(self.stride, self.stride))(out)
+        out = nn.relu(norm(name="bn2")(out))
+        out = conv(self.expansion * self.planes, (1, 1))(out)
+        out = norm(name="bn3")(out)
+
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != self.expansion * self.planes:
+            shortcut = conv(
+                self.expansion * self.planes, (1, 1),
+                strides=(self.stride, self.stride), name="shortcut_conv",
+            )(x)
+            shortcut = norm(name="shortcut_bn")(shortcut)
+        return nn.relu(out + shortcut)
+
+
+class ResNet(nn.Module):
+    """CIFAR-stem ResNet encoder -> [N, feat_dim] (reference resnet_big.py:70-118)."""
+
+    block_cls: Any = Bottleneck
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    in_channel: int = 3
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+    sync_bn: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
+        norm = partial(
+            CrossReplicaBatchNorm, axis_name=self.axis_name, sync=self.sync_bn
+        )
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            64, (3, 3), strides=(1, 1), use_bias=False,
+            kernel_init=conv_kernel_init, dtype=self.dtype, param_dtype=jnp.float32,
+            name="conv1",
+        )(x)
+        x = nn.relu(norm(use_running_average=not train, name="bn1")(x))
+        widths = (64, 128, 256, 512)
+        strides = (1, 2, 2, 2)
+        for stage, (n_blocks, width, stage_stride) in enumerate(
+            zip(self.stage_sizes, widths, strides)
+        ):
+            for block in range(n_blocks):
+                x = self.block_cls(
+                    planes=width,
+                    stride=stage_stride if block == 0 else 1,
+                    dtype=self.dtype,
+                    norm=norm,
+                    name=f"layer{stage + 1}_block{block}",
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool (AdaptiveAvgPool2d((1,1)))
+        return x.astype(jnp.float32)
+
+
+def resnet18(**kwargs) -> ResNet:
+    return ResNet(block_cls=BasicBlock, stage_sizes=(2, 2, 2, 2), **kwargs)
+
+
+def resnet34(**kwargs) -> ResNet:
+    return ResNet(block_cls=BasicBlock, stage_sizes=(3, 4, 6, 3), **kwargs)
+
+
+def resnet50(**kwargs) -> ResNet:
+    return ResNet(block_cls=Bottleneck, stage_sizes=(3, 4, 6, 3), **kwargs)
+
+
+def resnet101(**kwargs) -> ResNet:
+    return ResNet(block_cls=Bottleneck, stage_sizes=(3, 4, 23, 3), **kwargs)
+
+
+# name -> (constructor, feature dim); reference model_dict resnet_big.py:137-142.
+MODEL_DICT: dict[str, Tuple[Callable[..., ResNet], int]] = {
+    "resnet18": (resnet18, 512),
+    "resnet34": (resnet34, 512),
+    "resnet50": (resnet50, 2048),
+    "resnet101": (resnet101, 2048),
+}
