@@ -1,0 +1,8 @@
+from simclr_pytorch_distributed_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    create_mesh,
+    is_main_process,
+    replicated_sharding,
+    setup_distributed,
+    shard_host_batch,
+)

@@ -1,0 +1,100 @@
+"""Runtime / mesh layer — the TPU-native replacement for the reference's L1.
+
+The reference initializes one NCCL process per GPU via ``torch.distributed.launch``
+(``main_supcon.py:359-364``) and weaves collectives through DDP/SyncBN. Here the
+runtime is a single SPMD program:
+
+- one process per HOST (not per chip); ``jax.distributed.initialize()`` for
+  multi-host rendezvous (replaces the env:// MASTER_ADDR/PORT dance);
+- a ``jax.sharding.Mesh`` whose ``data`` axis spans every chip; collectives ride
+  ICI within a slice and DCN across slices, chosen by XLA from the shardings;
+- a second ``model`` axis is supported for future tensor-parallel layouts — the
+  reference has no model parallelism (SURVEY.md §2.2) so it defaults to size 1.
+
+"rank 0"-style I/O gating (reference ``main_supcon.py:137-148,327,397``) becomes
+``is_main_process()`` == ``jax.process_index() == 0``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def setup_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host rendezvous (replaces init_process_group, main_supcon.py:359-364).
+
+    No-op on a single host with no coordinator configured. On TPU pods the
+    arguments are normally inferred from the environment, so a bare
+    ``setup_distributed()`` suffices.
+    """
+    if coordinator_address is None and jax.process_count() == 1 and num_processes in (None, 1):
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "distributed: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def create_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    model_parallel: int = 1,
+    axis_names: Sequence[str] = (DATA_AXIS, MODEL_AXIS),
+) -> Mesh:
+    """Build a (data, model) mesh over all devices; model axis defaults to 1."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % model_parallel != 0:
+        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+    dev_array = np.array(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def is_main_process() -> bool:
+    """Process-0 gating for I/O (reference local_rank==0 checks)."""
+    return jax.process_index() == 0
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard the leading (batch) dim over 'data'; replicate everything else."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_host_batch(batch, mesh: Mesh):
+    """Place a host batch onto the mesh, sharded along 'data'.
+
+    Single-host: a plain ``device_put`` with the batch sharding (the whole array
+    is local). Multi-host: each process holds its own shard of the global batch
+    (the ``DistributedSampler`` equivalent lives in data/pipeline.py) and the
+    global array is assembled from process-local data.
+    """
+    def put(x):
+        sharding = batch_sharding(mesh, np.ndim(x))
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+    return jax.tree.map(put, batch)

@@ -1,0 +1,63 @@
+"""Functional train state + the torch-matching SGD optimizer chain.
+
+The reference's mutable training state is scattered across the DDP module, the
+torch SGD optimizer, and fields smuggled into the argparse namespace (the SEC
+EMA ``opt.record_norm_mean``, ``main_supcon.py:150,304-307``). Here it is one
+immutable pytree carried through the jitted step.
+
+``make_optimizer`` reproduces ``torch.optim.SGD(lr, momentum, weight_decay)``
+over ALL parameters (reference ``util.py:79-84`` — note BN scale/bias are weight-
+decayed too, which matters for the published recipe):
+``d_p = g + wd*p; buf = mu*buf + d_p; p -= lr*buf`` maps onto
+``add_decayed_weights -> trace(momentum) -> scale_by_learning_rate(schedule)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array  # 0-based global iteration
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    # SEC feature-norm EMA (reference opt.record_norm_mean, main_supcon.py:304-307).
+    record_norm_mean: jax.Array
+
+
+def make_optimizer(
+    learning_rate: Union[float, Callable],
+    momentum: float = 0.9,
+    weight_decay: float = 1e-4,
+) -> optax.GradientTransformation:
+    parts = []
+    if weight_decay:
+        parts.append(optax.add_decayed_weights(weight_decay))
+    if momentum:
+        parts.append(optax.trace(decay=momentum, nesterov=False))
+    parts.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*parts)
+
+
+def create_train_state(
+    model,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    example_input: jax.Array,
+) -> TrainState:
+    variables = model.init(rng, example_input, train=True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        record_norm_mean=jnp.zeros((), jnp.float32),
+    )
