@@ -1,0 +1,5 @@
+from simclr_pytorch_distributed_tpu.data.cifar import (  # noqa: F401
+    load_dataset,
+    synthetic_dataset,
+)
+from simclr_pytorch_distributed_tpu.data.pipeline import EpochLoader  # noqa: F401

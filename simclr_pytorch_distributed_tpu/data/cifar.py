@@ -1,0 +1,118 @@
+"""Dataset loading: CIFAR-10/100 from the standard on-disk binary distributions,
+plus a synthetic generator for data-free smoke tests and benchmarks.
+
+The reference pulls CIFAR through torchvision with ``download=True``
+(``main_supcon.py:181-188``). This environment has no egress and no torchvision,
+so we read the canonical python-pickle layout directly:
+
+- ``cifar-10-batches-py/{data_batch_1..5, test_batch}``: dict with ``data``
+  ``[N, 3072]`` uint8 channel-major and ``labels``;
+- ``cifar-100-python/{train, test}``: same with ``fine_labels``.
+
+Arrays come back HWC uint8 — augmentation converts to float on device
+(ops/augment.py), so the host never touches float image tensors.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, Tuple
+
+import numpy as np
+
+NumpyDataset = Dict[str, np.ndarray]  # images [N,32,32,3] u8, labels [N] i32
+
+
+def _decode_rows(data: np.ndarray) -> np.ndarray:
+    return data.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+
+
+def _load_pickle(path: str) -> dict:
+    with open(path, "rb") as f:
+        return pickle.load(f, encoding="latin1")
+
+
+def load_cifar10(data_folder: str) -> Tuple[NumpyDataset, NumpyDataset]:
+    root = os.path.join(data_folder, "cifar-10-batches-py")
+    train_x, train_y = [], []
+    for i in range(1, 6):
+        d = _load_pickle(os.path.join(root, f"data_batch_{i}"))
+        train_x.append(_decode_rows(np.asarray(d["data"], np.uint8)))
+        train_y.append(np.asarray(d["labels"], np.int32))
+    t = _load_pickle(os.path.join(root, "test_batch"))
+    train = {
+        "images": np.concatenate(train_x),
+        "labels": np.concatenate(train_y),
+    }
+    test = {
+        "images": _decode_rows(np.asarray(t["data"], np.uint8)),
+        "labels": np.asarray(t["labels"], np.int32),
+    }
+    return train, test
+
+
+def load_cifar100(data_folder: str) -> Tuple[NumpyDataset, NumpyDataset]:
+    root = os.path.join(data_folder, "cifar-100-python")
+    out = []
+    for split in ("train", "test"):
+        d = _load_pickle(os.path.join(root, split))
+        out.append(
+            {
+                "images": _decode_rows(np.asarray(d["data"], np.uint8)),
+                "labels": np.asarray(d["fine_labels"], np.int32),
+            }
+        )
+    return out[0], out[1]
+
+
+def synthetic_dataset(
+    n: int = 2048, num_classes: int = 10, seed: int = 0, size: int = 32
+) -> Tuple[NumpyDataset, NumpyDataset]:
+    """Class-conditional random images: enough structure that a linear probe can
+    beat chance, cheap enough for CI and throughput benches."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    # class-dependent color means + noise
+    class_means = rng.uniform(48, 208, size=(num_classes, 1, 1, 3))
+    noise = rng.normal(0, 32, size=(n, size, size, 3))
+    images = np.clip(class_means[labels] + noise, 0, 255).astype(np.uint8)
+    k = max(n // 8, 1)
+    train = {"images": images[k:], "labels": labels[k:]}
+    test = {"images": images[:k], "labels": labels[:k]}
+    return train, test
+
+
+def load_dataset(
+    dataset: str, data_folder: str, allow_synthetic_fallback: bool = False
+) -> Tuple[NumpyDataset, NumpyDataset, int]:
+    """Returns (train, test, num_classes). ``dataset`` in {cifar10, cifar100,
+    synthetic}; with ``allow_synthetic_fallback`` a missing on-disk dataset
+    degrades to synthetic data with a warning (benchmark environments)."""
+    import logging
+
+    if dataset == "cifar10":
+        n_cls, loader, marker = 10, load_cifar10, "cifar-10-batches-py"
+    elif dataset == "cifar100":
+        n_cls, loader, marker = 100, load_cifar100, "cifar-100-python"
+    elif dataset == "synthetic":
+        train, test = synthetic_dataset()
+        return train, test, 10
+    else:
+        raise ValueError(f"dataset not supported: {dataset}")
+
+    if not os.path.isdir(os.path.join(data_folder, marker)):
+        if allow_synthetic_fallback:
+            logging.warning(
+                "%s not found under %s — falling back to synthetic data",
+                marker, data_folder,
+            )
+            train, test = synthetic_dataset(num_classes=n_cls)
+            return train, test, n_cls
+        raise FileNotFoundError(
+            f"{marker} not found under {data_folder} (no egress to download; "
+            f"place the standard python version of {dataset} there, or pass "
+            f"--dataset synthetic)"
+        )
+    train, test = loader(data_folder)
+    return train, test, n_cls

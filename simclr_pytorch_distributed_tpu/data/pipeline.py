@@ -1,0 +1,82 @@
+"""Host-side batch iteration: the DistributedSampler + DataLoader equivalent.
+
+The reference shards the dataset across ranks with ``DistributedSampler`` and
+reshuffles per epoch via ``sampler.set_epoch(epoch)`` (``main_supcon.py:195-199,
+387``), dropping the last partial batch. Here:
+
+- one deterministic permutation per epoch (seeded by ``base_seed + epoch``) —
+  identical on every process, so the global batch composition is well-defined;
+- ``drop_last`` truncation to whole GLOBAL batches (``main_supcon.py:206``);
+- each process slices its contiguous block of every global batch
+  (``process_index * per_proc : ... + per_proc``) — the multi-host analogue of
+  per-rank ``batch_size // ngpu`` (``main_supcon.py:202``). Single host = the
+  whole batch. The global array is reassembled on device by
+  ``parallel.mesh.shard_host_batch``.
+
+Augmentation is NOT here — it runs on device (ops/augment.py), so this loader
+only permutes uint8 arrays and hands out views; there is nothing left for a
+worker pool to do (the reference's ``num_workers=8`` host pipeline disappears).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+import numpy as np
+
+
+class EpochLoader:
+    """Iterates (images_u8, labels) process-local slices of global batches."""
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        global_batch_size: int,
+        *,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        base_seed: int = 0,
+        process_index: int = 0,
+        process_count: int = 1,
+    ):
+        if global_batch_size % process_count != 0:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"{process_count} processes"
+            )
+        self.images = images
+        self.labels = labels
+        self.global_batch_size = global_batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.base_seed = base_seed
+        self.process_index = process_index
+        self.process_count = process_count
+        n = len(images)
+        if drop_last:
+            self.steps_per_epoch = n // global_batch_size
+        else:
+            self.steps_per_epoch = (n + global_batch_size - 1) // global_batch_size
+        if self.steps_per_epoch == 0:
+            raise ValueError(
+                f"dataset of {n} examples smaller than one global batch "
+                f"({global_batch_size})"
+            )
+
+    def epoch(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """One pass; ``epoch`` seeds the shuffle (sampler.set_epoch equivalent)."""
+        n = len(self.images)
+        if self.shuffle:
+            order = np.random.default_rng(self.base_seed + epoch).permutation(n)
+        else:
+            order = np.arange(n)
+        per_proc = self.global_batch_size // self.process_count
+        lo = self.process_index * per_proc
+        for step in range(self.steps_per_epoch):
+            sel = order[step * self.global_batch_size:(step + 1) * self.global_batch_size]
+            sel = sel[lo:lo + per_proc]
+            yield self.images[sel], self.labels[sel]
+
+    def __len__(self) -> int:
+        return self.steps_per_epoch

@@ -1,0 +1,294 @@
+"""Device-side SimCLR augmentations — jittable, vmapped, XLA-fused.
+
+The reference augments on the host with 8 PIL DataLoader workers per GPU
+(``main_supcon.py:170-207``). TPU-natively the whole stack runs jitted on device:
+uint8 batches stream over PCIe (12x smaller than fp32), and the aug pipeline
+fuses into the train step, so HBM sees each image once.
+
+Semantics follow the recipe's torchvision stack (``main_supcon.py:170-179``):
+
+- ``RandomResizedCrop(size, scale=(0.2, 1.0))`` — including torchvision's
+  10-attempt area/aspect sampling with center-crop fallback, implemented as a
+  vectorized first-valid selection (static shapes, no data-dependent loops);
+- ``RandomHorizontalFlip`` (p=0.5);
+- ``ColorJitter(0.4, 0.4, 0.4, 0.1)`` applied with p=0.8, with torchvision's
+  uniformly-sampled factors AND randomly permuted op order;
+- ``RandomGrayscale(p=0.2)`` (ITU-R 601 luma);
+- normalize with per-dataset mean/std (``main_supcon.py:157-162``).
+
+All ops take/return float images in [0, 1], HWC. Geometry uses half-pixel-center
+bilinear sampling; crops are never larger than the source (32x32 -> <=32 crop ->
+upscale), so PIL's antialiased downscale path never engages and plain bilinear
+matches the host implementation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Per-dataset normalization constants (main_supcon.py:157-162 / main_ce.py:21-26).
+DATASET_STATS = {
+    "cifar10": ((0.4914, 0.4822, 0.4465), (0.2023, 0.1994, 0.2010)),
+    "cifar100": ((0.5071, 0.4867, 0.4408), (0.2675, 0.2565, 0.2761)),
+}
+
+
+def _bilinear_sample(img: jax.Array, ys: jax.Array, xs: jax.Array) -> jax.Array:
+    """Sample img[H,W,C] at float coords (ys, xs) grids with edge clamping."""
+    H, W = img.shape[0], img.shape[1]
+    # clamp-to-edge BEFORE flooring so out-of-range coords replicate the border
+    ys = jnp.clip(ys, 0.0, H - 1.0)
+    xs = jnp.clip(xs, 0.0, W - 1.0)
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+    y0i = jnp.clip(y0.astype(jnp.int32), 0, H - 1)
+    y1i = jnp.clip(y0i + 1, 0, H - 1)
+    x0i = jnp.clip(x0.astype(jnp.int32), 0, W - 1)
+    x1i = jnp.clip(x0i + 1, 0, W - 1)
+
+    def g(yi, xi):
+        return img[yi[:, None], xi[None, :], :]
+
+    top = g(y0i, x0i) * (1 - wx)[None, :, None] + g(y0i, x1i) * wx[None, :, None]
+    bot = g(y1i, x0i) * (1 - wx)[None, :, None] + g(y1i, x1i) * wx[None, :, None]
+    return top * (1 - wy)[:, None, None] + bot * wy[:, None, None]
+
+
+def crop_and_resize(
+    img: jax.Array, top: jax.Array, left: jax.Array, h: jax.Array, w: jax.Array,
+    out_size: int,
+) -> jax.Array:
+    """Bilinear-resize the (top, left, h, w) crop to (out_size, out_size).
+
+    h/w/top/left are traced scalars — the crop+resize is expressed as one gather
+    (dynamic_slice can't take traced sizes), which XLA lowers well on TPU.
+    Half-pixel-center convention matches PIL/torchvision bilinear resize.
+    """
+    d = jnp.arange(out_size, dtype=jnp.float32)
+    ys = top + (d + 0.5) * (h / out_size) - 0.5
+    xs = left + (d + 0.5) * (w / out_size) - 0.5
+    return _bilinear_sample(img, ys, xs)
+
+
+def random_resized_crop(
+    key: jax.Array,
+    img: jax.Array,
+    size: int,
+    scale: Tuple[float, float] = (0.2, 1.0),
+    ratio: Tuple[float, float] = (3.0 / 4.0, 4.0 / 3.0),
+    attempts: int = 10,
+) -> jax.Array:
+    """torchvision RandomResizedCrop: 10 area/aspect attempts, first valid wins,
+    else the aspect-clamped center-crop fallback."""
+    H, W = img.shape[0], img.shape[1]
+    area = float(H * W)
+    k_area, k_ratio, k_ij = jax.random.split(key, 3)
+
+    target_area = area * jax.random.uniform(
+        k_area, (attempts,), minval=scale[0], maxval=scale[1]
+    )
+    log_ratio = jax.random.uniform(
+        k_ratio, (attempts,),
+        minval=math.log(ratio[0]), maxval=math.log(ratio[1]),
+    )
+    aspect = jnp.exp(log_ratio)
+    ws = jnp.round(jnp.sqrt(target_area * aspect))
+    hs = jnp.round(jnp.sqrt(target_area / aspect))
+    valid = (ws > 0) & (ws <= W) & (hs > 0) & (hs <= H)
+    # first valid attempt (torchvision returns on first success)
+    idx = jnp.argmax(valid)
+    any_valid = jnp.any(valid)
+    w = ws[idx]
+    h = hs[idx]
+
+    # fallback: clamp aspect to the ratio range, center crop (torchvision tail).
+    # H/W are static so this resolves at trace time.
+    in_ratio = W / H
+    if in_ratio < ratio[0]:
+        fb_w, fb_h = float(W), float(round(W / ratio[0]))
+    elif in_ratio > ratio[1]:
+        fb_w, fb_h = float(round(H * ratio[1])), float(H)
+    else:
+        fb_w, fb_h = float(W), float(H)
+    w = jnp.where(any_valid, w, fb_w)
+    h = jnp.where(any_valid, h, fb_h)
+
+    u_top, u_left = jax.random.uniform(k_ij, (2,))
+    top = jnp.where(any_valid, jnp.floor(u_top * (H - h + 1)), jnp.round((H - h) / 2.0))
+    left = jnp.where(any_valid, jnp.floor(u_left * (W - w + 1)), jnp.round((W - w) / 2.0))
+    return crop_and_resize(img, top, left, h, w, size)
+
+
+def random_horizontal_flip(key: jax.Array, img: jax.Array, p: float = 0.5) -> jax.Array:
+    return jnp.where(jax.random.bernoulli(key, p), img[:, ::-1, :], img)
+
+
+def _grayscale(img: jax.Array) -> jax.Array:
+    """ITU-R 601 luma (PIL 'L' weights), single channel kept as last dim."""
+    w = jnp.array([0.299, 0.587, 0.114], img.dtype)
+    return jnp.sum(img * w, axis=-1, keepdims=True)
+
+
+def adjust_brightness(img: jax.Array, factor: jax.Array) -> jax.Array:
+    return jnp.clip(img * factor, 0.0, 1.0)
+
+
+def adjust_contrast(img: jax.Array, factor: jax.Array) -> jax.Array:
+    mean = jnp.mean(_grayscale(img))
+    return jnp.clip(factor * img + (1.0 - factor) * mean, 0.0, 1.0)
+
+
+def adjust_saturation(img: jax.Array, factor: jax.Array) -> jax.Array:
+    gray = _grayscale(img)
+    return jnp.clip(factor * img + (1.0 - factor) * gray, 0.0, 1.0)
+
+
+def adjust_hue(img: jax.Array, delta: jax.Array) -> jax.Array:
+    """Shift hue by delta (in turns, [-0.5, 0.5]) via HSV round-trip."""
+    r, g, b = img[..., 0], img[..., 1], img[..., 2]
+    maxc = jnp.maximum(jnp.maximum(r, g), b)
+    minc = jnp.minimum(jnp.minimum(r, g), b)
+    v = maxc
+    c = maxc - minc
+    s = jnp.where(maxc > 0, c / jnp.maximum(maxc, 1e-12), 0.0)
+    safe_c = jnp.maximum(c, 1e-12)
+    rc = (maxc - r) / safe_c
+    gc = (maxc - g) / safe_c
+    bc = (maxc - b) / safe_c
+    h = jnp.where(
+        r == maxc, bc - gc, jnp.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc)
+    )
+    h = (h / 6.0) % 1.0
+    h = jnp.where(c == 0, 0.0, h)
+
+    h = (h + delta) % 1.0
+
+    i = jnp.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(jnp.int32) % 6
+    r2 = jnp.choose(i, [v, q, p, p, t, v], mode="clip")
+    g2 = jnp.choose(i, [t, v, v, q, p, p], mode="clip")
+    b2 = jnp.choose(i, [p, p, t, v, v, q], mode="clip")
+    return jnp.stack([r2, g2, b2], axis=-1)
+
+
+def color_jitter(
+    key: jax.Array,
+    img: jax.Array,
+    brightness: float = 0.4,
+    contrast: float = 0.4,
+    saturation: float = 0.4,
+    hue: float = 0.1,
+) -> jax.Array:
+    """torchvision ColorJitter: uniform factors, randomly permuted op order."""
+    k_perm, k_b, k_c, k_s, k_h = jax.random.split(key, 5)
+    fb = jax.random.uniform(k_b, (), minval=1 - brightness, maxval=1 + brightness)
+    fc = jax.random.uniform(k_c, (), minval=1 - contrast, maxval=1 + contrast)
+    fs = jax.random.uniform(k_s, (), minval=1 - saturation, maxval=1 + saturation)
+    fh = jax.random.uniform(k_h, (), minval=-hue, maxval=hue)
+
+    branches = (
+        lambda x: adjust_brightness(x, fb),
+        lambda x: adjust_contrast(x, fc),
+        lambda x: adjust_saturation(x, fs),
+        lambda x: adjust_hue(x, fh),
+    )
+    order = jax.random.permutation(k_perm, 4)
+
+    def body(i, x):
+        return jax.lax.switch(order[i], branches, x)
+
+    return jax.lax.fori_loop(0, 4, body, img)
+
+
+def random_apply(key: jax.Array, fn, img: jax.Array, p: float) -> jax.Array:
+    k_gate, k_fn = jax.random.split(key)
+    return jnp.where(jax.random.bernoulli(k_gate, p), fn(k_fn, img), img)
+
+
+def random_grayscale(key: jax.Array, img: jax.Array, p: float = 0.2) -> jax.Array:
+    gray3 = jnp.broadcast_to(_grayscale(img), img.shape)
+    return jnp.where(jax.random.bernoulli(key, p), gray3, img)
+
+
+def normalize(img: jax.Array, mean: Sequence[float], std: Sequence[float]) -> jax.Array:
+    mean = jnp.asarray(mean, img.dtype)
+    std = jnp.asarray(std, img.dtype)
+    return (img - mean) / std
+
+
+def to_float(img_u8: jax.Array) -> jax.Array:
+    return img_u8.astype(jnp.float32) / 255.0
+
+
+@dataclasses.dataclass(frozen=True)
+class AugmentConfig:
+    """The contrastive-pretrain transform stack (main_supcon.py:170-179)."""
+
+    size: int = 32
+    scale: Tuple[float, float] = (0.2, 1.0)
+    jitter_prob: float = 0.8
+    jitter_strength: Tuple[float, float, float, float] = (0.4, 0.4, 0.4, 0.1)
+    grayscale_prob: float = 0.2
+    mean: Tuple[float, ...] = DATASET_STATS["cifar10"][0]
+    std: Tuple[float, ...] = DATASET_STATS["cifar10"][1]
+    # linear/CE stage drops jitter+grayscale (main_ce.py:31-36)
+    color_ops: bool = True
+
+
+def simclr_transform(key: jax.Array, img_u8: jax.Array, cfg: AugmentConfig) -> jax.Array:
+    """One augmented view of one image: uint8 HWC -> normalized float HWC."""
+    img = to_float(img_u8)
+    k_crop, k_flip, k_jit, k_gray = jax.random.split(key, 4)
+    img = random_resized_crop(k_crop, img, cfg.size, cfg.scale)
+    img = random_horizontal_flip(k_flip, img)
+    if cfg.color_ops:
+        b, c, s, h = cfg.jitter_strength
+        img = random_apply(
+            k_jit, partial(color_jitter, brightness=b, contrast=c, saturation=s, hue=h),
+            img, cfg.jitter_prob,
+        )
+        img = random_grayscale(k_gray, img)
+    return normalize(img, cfg.mean, cfg.std)
+
+
+def eval_transform(img_u8: jax.Array, cfg: AugmentConfig) -> jax.Array:
+    """Validation path: ToTensor + normalize only (main_ce.py:38-41)."""
+    return normalize(to_float(img_u8), cfg.mean, cfg.std)
+
+
+def two_crop_batch(key: jax.Array, images_u8: jax.Array, cfg: AugmentConfig) -> jax.Array:
+    """TwoCropTransform over a batch: [B,H,W,C] uint8 -> [B,2,size,size,C] float.
+
+    Two independent transform draws per image (util.py:10-16).
+    """
+    B = images_u8.shape[0]
+    keys = jax.random.split(key, 2 * B).reshape(B, 2)
+
+    def per_image(ks, img):
+        v1 = simclr_transform(ks[0], img, cfg)
+        v2 = simclr_transform(ks[1], img, cfg)
+        return jnp.stack([v1, v2])
+
+    return jax.vmap(per_image)(keys, images_u8)
+
+
+def augment_batch(key: jax.Array, images_u8: jax.Array, cfg: AugmentConfig) -> jax.Array:
+    """Single-view augmentation over a batch (linear/CE train stage)."""
+    keys = jax.random.split(key, images_u8.shape[0])
+    return jax.vmap(lambda k, im: simclr_transform(k, im, cfg))(keys, images_u8)
+
+
+def eval_batch(images_u8: jax.Array, cfg: AugmentConfig) -> jax.Array:
+    return jax.vmap(lambda im: eval_transform(im, cfg))(images_u8)

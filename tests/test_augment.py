@@ -1,0 +1,181 @@
+"""Augmentation tests: geometry, color-op numerics vs direct formulas,
+probabilities over many keys, and batch plumbing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu.ops.augment import (
+    AugmentConfig,
+    adjust_brightness,
+    adjust_contrast,
+    adjust_hue,
+    adjust_saturation,
+    augment_batch,
+    color_jitter,
+    crop_and_resize,
+    eval_batch,
+    normalize,
+    random_grayscale,
+    random_horizontal_flip,
+    random_resized_crop,
+    simclr_transform,
+    two_crop_batch,
+)
+
+CFG = AugmentConfig()
+
+
+def rand_img(rng, h=32, w=32):
+    return rng.uniform(0, 1, size=(h, w, 3)).astype(np.float32)
+
+
+def test_crop_and_resize_identity(rng):
+    img = jnp.asarray(rand_img(rng))
+    out = crop_and_resize(img, jnp.float32(0), jnp.float32(0), jnp.float32(32), jnp.float32(32), 32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(img), atol=1e-6)
+
+
+def test_crop_and_resize_upscale_constant(rng):
+    img = jnp.ones((32, 32, 3)) * 0.5
+    out = crop_and_resize(img, jnp.float32(4), jnp.float32(7), jnp.float32(10), jnp.float32(12), 32)
+    np.testing.assert_allclose(np.asarray(out), 0.5, atol=1e-6)
+
+
+def test_crop_and_resize_2x_upscale_exact():
+    """2x upsample of a 2x2 checker with half-pixel centers: corners keep values."""
+    img = jnp.asarray([[0.0, 1.0], [1.0, 0.0]]).reshape(2, 2, 1)
+    out = crop_and_resize(img, jnp.float32(0), jnp.float32(0), jnp.float32(2), jnp.float32(2), 4)
+    out = np.asarray(out)[..., 0]
+    np.testing.assert_allclose(out[0, 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(out[0, 3], 1.0, atol=1e-6)
+    # dst (1,1) -> src (0.25, 0.25): 0.75^2*0 + 2*0.25*0.75*1 + 0.25^2*0
+    np.testing.assert_allclose(out[1, 1], 0.375, atol=1e-6)
+
+
+def test_rrc_shapes_and_range(rng):
+    img = jnp.asarray(rand_img(rng))
+    out = random_resized_crop(jax.random.key(0), img, 32)
+    assert out.shape == (32, 32, 3)
+    assert float(out.min()) >= -1e-6 and float(out.max()) <= 1 + 1e-6
+
+
+def test_rrc_scale_statistics(rng):
+    """Sampled crop areas should span the (0.2, 1.0) scale range: a constant
+    gradient image's crop mean varies; check variability across keys."""
+    img = jnp.asarray(np.linspace(0, 1, 32 * 32 * 3).reshape(32, 32, 3).astype(np.float32))
+    outs = jax.vmap(lambda k: random_resized_crop(k, img, 32))(
+        jax.random.split(jax.random.key(0), 64)
+    )
+    means = np.asarray(outs.mean(axis=(1, 2, 3)))
+    assert means.std() > 0.02  # crops differ
+    # every output is a valid resample of the source range
+    assert outs.min() >= 0 and outs.max() <= 1 + 1e-6
+
+
+def test_hflip_probability():
+    img = jnp.asarray(np.arange(32 * 32 * 3, dtype=np.float32).reshape(32, 32, 3))
+    keys = jax.random.split(jax.random.key(0), 400)
+    flipped = jax.vmap(lambda k: random_horizontal_flip(k, img)[0, 0, 0])(keys)
+    frac = float(jnp.mean(flipped != img[0, 0, 0]))
+    assert 0.4 < frac < 0.6
+
+
+def test_brightness_contrast_saturation_formulas(rng):
+    img = jnp.asarray(rand_img(rng))
+    np.testing.assert_allclose(
+        np.asarray(adjust_brightness(img, 0.5)), np.clip(np.asarray(img) * 0.5, 0, 1), atol=1e-6
+    )
+    x = np.asarray(img)
+    gray = (x * [0.299, 0.587, 0.114]).sum(-1, keepdims=True)
+    np.testing.assert_allclose(
+        np.asarray(adjust_saturation(img, 1.3)),
+        np.clip(1.3 * x + (1 - 1.3) * gray, 0, 1), atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(adjust_contrast(img, 0.7)),
+        np.clip(0.7 * x + 0.3 * gray.mean(), 0, 1), atol=1e-5,
+    )
+
+
+def test_hue_roundtrip(rng):
+    img = jnp.asarray(rand_img(rng))
+    out = adjust_hue(img, jnp.float32(0.0))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(img), atol=1e-5)
+    # full rotation returns to start
+    out = adjust_hue(adjust_hue(img, jnp.float32(0.5)), jnp.float32(0.5))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(img), atol=1e-4)
+
+
+def test_hue_shift_changes_channels(rng):
+    img = jnp.asarray(rand_img(rng))
+    out = adjust_hue(img, jnp.float32(0.1))
+    assert not np.allclose(np.asarray(out), np.asarray(img), atol=1e-3)
+    # value (max channel) is preserved by pure hue shifts
+    np.testing.assert_allclose(
+        np.asarray(out.max(axis=-1)), np.asarray(img.max(axis=-1)), atol=1e-5
+    )
+
+
+def test_grayscale_probability_and_channels(rng):
+    img = jnp.asarray(rand_img(rng))
+    keys = jax.random.split(jax.random.key(1), 400)
+    outs = jax.vmap(lambda k: random_grayscale(k, img))(keys)
+    outs = np.asarray(outs)
+    is_gray = np.all(np.abs(outs[..., 0] - outs[..., 1]) < 1e-6, axis=(1, 2))
+    assert 0.12 < is_gray.mean() < 0.30  # p=0.2
+
+
+def test_color_jitter_order_matters_and_is_applied(rng):
+    img = jnp.asarray(rand_img(rng))
+    out1 = color_jitter(jax.random.key(0), img)
+    out2 = color_jitter(jax.random.key(1), img)
+    assert not np.allclose(np.asarray(out1), np.asarray(out2), atol=1e-4)
+
+
+def test_normalize():
+    img = jnp.ones((4, 4, 3)) * 0.5
+    out = normalize(img, CFG.mean, CFG.std)
+    want = (0.5 - np.array(CFG.mean)) / np.array(CFG.std)
+    np.testing.assert_allclose(np.asarray(out[0, 0]), want, rtol=1e-5)
+
+
+def test_two_crop_batch_shapes_and_independence(rng):
+    imgs = (rand_img(rng, 32, 32) * 255).astype(np.uint8)[None].repeat(4, axis=0)
+    out = two_crop_batch(jax.random.key(0), jnp.asarray(imgs), CFG)
+    assert out.shape == (4, 2, 32, 32, 3)
+    out = np.asarray(out)
+    # the two views of the same image must differ (independent transform draws)
+    assert not np.allclose(out[:, 0], out[:, 1], atol=1e-3)
+    # different batch elements get different randomness even for identical input
+    assert not np.allclose(out[0, 0], out[1, 0], atol=1e-3)
+
+
+def test_eval_batch_deterministic(rng):
+    imgs = (rand_img(rng) * 255).astype(np.uint8)[None]
+    a = eval_batch(jnp.asarray(imgs), CFG)
+    b = eval_batch(jnp.asarray(imgs), CFG)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_simclr_transform_jits(rng):
+    img = jnp.asarray((rand_img(rng) * 255).astype(np.uint8))
+    f = jax.jit(lambda k, im: simclr_transform(k, im, CFG))
+    out = f(jax.random.key(0), img)
+    assert out.shape == (32, 32, 3)
+    assert np.isfinite(np.asarray(out)).all()
+    # deterministic per key
+    np.testing.assert_array_equal(
+        np.asarray(f(jax.random.key(5), img)), np.asarray(f(jax.random.key(5), img))
+    )
+
+
+def test_augment_batch_no_color_ops(rng):
+    """Linear/CE stage: RRC+flip+normalize only — gray pixels stay gray."""
+    cfg = AugmentConfig(color_ops=False)
+    gray_val = 128
+    imgs = np.full((2, 32, 32, 3), gray_val, np.uint8)
+    out = np.asarray(augment_batch(jax.random.key(0), jnp.asarray(imgs), cfg))
+    want = (gray_val / 255.0 - np.array(cfg.mean)) / np.array(cfg.std)
+    np.testing.assert_allclose(out, np.broadcast_to(want, out.shape), atol=1e-4)

@@ -1,0 +1,112 @@
+"""Data pipeline tests: synthetic datasets, CIFAR binary decoding, epoch loader
+sharding/shuffle/drop_last semantics."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu.data.cifar import (
+    load_cifar10,
+    load_dataset,
+    synthetic_dataset,
+)
+from simclr_pytorch_distributed_tpu.data.pipeline import EpochLoader
+
+
+def test_synthetic_dataset_structure():
+    train, test = synthetic_dataset(n=256, num_classes=10, seed=0)
+    assert train["images"].dtype == np.uint8
+    assert train["images"].shape[1:] == (32, 32, 3)
+    assert train["labels"].min() >= 0 and train["labels"].max() < 10
+    assert len(test["images"]) == 32
+    # class conditionality: per-class image means differ
+    m0 = train["images"][train["labels"] == 0].mean()
+    m1 = train["images"][train["labels"] == 1].mean()
+    assert abs(m0 - m1) > 1.0
+
+
+def test_load_cifar10_binary_format(tmp_path):
+    """Write the canonical pickle layout and read it back."""
+    root = tmp_path / "cifar-10-batches-py"
+    os.makedirs(root)
+    rng = np.random.default_rng(0)
+    for i in range(1, 6):
+        data = rng.integers(0, 256, size=(20, 3072), dtype=np.uint8)
+        with open(root / f"data_batch_{i}", "wb") as f:
+            pickle.dump({"data": data, "labels": list(rng.integers(0, 10, 20))}, f)
+    with open(root / "test_batch", "wb") as f:
+        pickle.dump(
+            {"data": rng.integers(0, 256, size=(10, 3072), dtype=np.uint8),
+             "labels": list(rng.integers(0, 10, 10))}, f)
+
+    train, test = load_cifar10(str(tmp_path))
+    assert train["images"].shape == (100, 32, 32, 3)
+    assert test["images"].shape == (10, 32, 32, 3)
+
+    # channel-major decode: row = [R plane, G plane, B plane]
+    row = np.arange(3072, dtype=np.uint8)
+    with open(root / "data_batch_1", "wb") as f:
+        pickle.dump({"data": row[None], "labels": [0]}, f)
+    for i in range(2, 6):
+        with open(root / f"data_batch_{i}", "wb") as f:
+            pickle.dump({"data": row[None] * 0, "labels": [0]}, f)
+    train, _ = load_cifar10(str(tmp_path))
+    img = train["images"][0]
+    assert img[0, 0, 0] == 0        # R plane starts at 0
+    assert img[0, 1, 0] == 1
+    assert img[0, 0, 1] == 1024 % 256  # G plane offset 1024
+
+
+def test_load_dataset_fallback(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_dataset("cifar10", str(tmp_path))
+    train, test, n_cls = load_dataset(
+        "cifar10", str(tmp_path), allow_synthetic_fallback=True
+    )
+    assert n_cls == 10 and len(train["images"]) > 0
+
+
+def test_epoch_loader_drop_last_and_shuffle():
+    images = np.arange(25)[:, None].astype(np.uint8)
+    labels = np.arange(25).astype(np.int32)
+    loader = EpochLoader(images, labels, global_batch_size=8, base_seed=7)
+    assert len(loader) == 3  # drop_last: 25 // 8
+
+    seen1 = np.concatenate([lab for _, lab in loader.epoch(1)])
+    seen1b = np.concatenate([lab for _, lab in loader.epoch(1)])
+    seen2 = np.concatenate([lab for _, lab in loader.epoch(2)])
+    assert len(seen1) == 24
+    np.testing.assert_array_equal(seen1, seen1b)  # same epoch -> same order
+    assert not np.array_equal(seen1, seen2)  # set_epoch reshuffles
+
+
+def test_epoch_loader_process_sharding():
+    """Process slices partition every global batch, matching batch//nproc."""
+    images = np.arange(64)[:, None].astype(np.uint8)
+    labels = np.arange(64).astype(np.int32)
+    shards = []
+    for p in range(4):
+        loader = EpochLoader(
+            images, labels, global_batch_size=16,
+            process_index=p, process_count=4, base_seed=3,
+        )
+        shards.append([lab for _, lab in loader.epoch(5)])
+    for step in range(4):
+        merged = np.concatenate([shards[p][step] for p in range(4)])
+        assert len(merged) == 16
+        assert len(np.unique(merged)) == 16  # disjoint slices
+        assert all(len(shards[p][step]) == 4 for p in range(4))
+
+
+def test_epoch_loader_validation_mode():
+    images = np.arange(10)[:, None].astype(np.uint8)
+    labels = np.arange(10).astype(np.int32)
+    loader = EpochLoader(
+        images, labels, global_batch_size=4, shuffle=False, drop_last=False
+    )
+    batches = list(loader.epoch(0))
+    assert len(batches) == 3
+    np.testing.assert_array_equal(batches[0][1], [0, 1, 2, 3])
+    np.testing.assert_array_equal(batches[2][1], [8, 9])  # ragged tail kept
