@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Pretrain throughput benchmark: imgs/sec/chip on the recipe workload.
+
+Runs the fused SimCLR train step (device-side two-crop augmentation + ResNet-50
+forward/backward + global NT-Xent + SGD) at the published recipe config
+(bs=256 global, 32x32, temp 0.5, SyncBN) on the available chips and prints ONE
+JSON line. The reference publishes no throughput numbers (BASELINE.json
+``published`` is empty), so ``vs_baseline`` is reported as 1.0.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from simclr_pytorch_distributed_tpu.models import SupConResNet
+    from simclr_pytorch_distributed_tpu.ops.augment import AugmentConfig
+    from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
+    from simclr_pytorch_distributed_tpu.parallel.mesh import (
+        create_mesh,
+        shard_host_batch,
+    )
+    from simclr_pytorch_distributed_tpu.train.state import (
+        create_train_state,
+        make_optimizer,
+    )
+    from simclr_pytorch_distributed_tpu.train.supcon import make_fused_update
+    from simclr_pytorch_distributed_tpu.train.supcon_step import SupConStepConfig
+
+    n_chips = len(jax.devices())
+    mesh = create_mesh()
+    batch, size = 256, 32
+    steps_per_epoch = 50000 // batch
+
+    # bf16 compute on the MXU; fp32 params/BN stats/loss.
+    model = SupConResNet(
+        model_name="resnet50", head="mlp", feat_dim=128, dtype=jnp.bfloat16
+    )
+    schedule = make_lr_schedule(
+        learning_rate=0.5, epochs=100, steps_per_epoch=steps_per_epoch, cosine=True
+    )
+    tx = make_optimizer(schedule, momentum=0.9, weight_decay=1e-4)
+    state = create_train_state(
+        model, tx, jax.random.key(0), jnp.zeros((2, size, size, 3))
+    )
+    step_cfg = SupConStepConfig(
+        method="SimCLR", temperature=0.5, epochs=100,
+        steps_per_epoch=steps_per_epoch, grad_div=2.0,
+    )
+    update = make_fused_update(
+        model, tx, schedule, step_cfg, AugmentConfig(size=size), mesh, state
+    )
+
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(batch, size, size, 3), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=(batch,)).astype(np.int32)
+    sh_images, sh_labels = shard_host_batch((images, labels), mesh)
+
+    # warmup (compile + first steps)
+    for i in range(3):
+        state, metrics = update(state, sh_images, sh_labels, jax.random.key(i))
+    jax.block_until_ready(state.params)
+
+    n_steps = 30
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        state, metrics = update(state, sh_images, sh_labels, jax.random.key(100 + i))
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = n_steps * batch / dt
+    per_chip = imgs_per_sec / n_chips
+    print(json.dumps({
+        "metric": "pretrain_imgs_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "imgs/s/chip",
+        "vs_baseline": 1.0,
+        "detail": {
+            "global_batch": batch,
+            "chips": n_chips,
+            "total_imgs_per_sec": round(imgs_per_sec, 1),
+            "step_ms": round(1000 * dt / n_steps, 2),
+            "config": "SimCLR rn50 cifar-recipe bf16 fused-aug",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

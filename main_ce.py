@@ -1,0 +1,8 @@
+#!/usr/bin/env python
+"""Supervised cross-entropy baseline entry point (rebuilds the trainer the
+reference fork lost — main_ce.py only kept set_loader)."""
+
+from simclr_pytorch_distributed_tpu.train.ce import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+#!/usr/bin/env python
+"""Linear-probe evaluation entry point (reference main_linear.py)."""
+
+from simclr_pytorch_distributed_tpu.train.linear import main
+
+if __name__ == "__main__":
+    main()

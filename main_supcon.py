@@ -1,0 +1,12 @@
+#!/usr/bin/env python
+"""Distributed contrastive pretraining entry point (reference main_supcon.py).
+
+No process launcher needed: one process per HOST drives all local chips via the
+mesh. On a single v5e-8 just run `python main_supcon.py ...` with the same flags
+as the reference.
+"""
+
+from simclr_pytorch_distributed_tpu.train.supcon import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# TPU equivalent of the reference run_linear.sh (single-GPU linear probe).
+# Usage: ./run_linear.sh --ckpt work_space/cifar10_models/<run>/last
+python main_linear.py \
+  --learning_rate 5 \
+  --batch_size 256 \
+  "$@"

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# TPU equivalent of the reference run_supcon.sh (2-GPU DDP launch):
+# no torch.distributed.launch — one process drives every local chip via the mesh.
+# --ngpu 2 keeps the reference's DDP gradient-scale for recipe parity.
+python main_supcon.py \
+  --syncBN \
+  --epochs 100 \
+  --batch_size 256 \
+  --learning_rate 0.5 \
+  --temp 0.5 \
+  --cosine \
+  --method SimCLR \
+  --ngpu 2 \
+  "$@"

@@ -1,0 +1,292 @@
+"""Configuration: the reference's argparse surface, dataclass-backed.
+
+Flag names, defaults, and DERIVED fields match the reference parsers —
+``main_supcon.py:22-152`` (pretrain), ``main_linear.py:21-116`` (probe), and the
+CE baseline (whose parser was lost in the reference fork; rebuilt from the
+probe's). The derivations that matter for recipe parity are kept bit-identical:
+
+- ``model_name`` run-string encoding (``main_supcon.py:109-117``);
+- auto-warmup when ``batch_size > 256`` (``:120-121``);
+- closed-form ``warmup_to`` (``:124-131``, via ops/schedules.warmup_to_value);
+- timestamped tb/save folder layout (``:133-142``), created on the main process.
+
+TPU-native additions (not in the reference): ``--bf16`` compute dtype,
+``--resume`` full-state resume, ``--model_parallel`` mesh axis size,
+``--seed``, ``--dataset synthetic``, ``--workdir``. The reference's ``--ngpu``
+flag is kept but means "DDP gradient-scale equivalence divisor" (see
+train/supcon_step.py) — actual parallelism comes from the mesh, not a flag.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import datetime
+import logging
+import os
+from typing import Optional, Tuple
+
+from simclr_pytorch_distributed_tpu.ops.schedules import warmup_to_value
+from simclr_pytorch_distributed_tpu.parallel.mesh import is_main_process
+
+
+@dataclasses.dataclass
+class SupConConfig:
+    # cadence
+    print_freq: int = 10
+    save_freq: int = 20
+    batch_size: int = 256
+    num_workers: int = 16  # CLI-parity only: augmentation runs on device
+    epochs: int = 1000
+    # optimization (main_supcon.py:37-47)
+    learning_rate: float = 0.5
+    lr_decay_epochs: Tuple[int, ...] = (700, 800, 900)
+    lr_decay_rate: float = 0.1
+    weight_decay: float = 1e-4
+    momentum: float = 0.9
+    # model / dataset (main_supcon.py:49-56)
+    model: str = "resnet50"
+    dataset: str = "cifar10"  # {cifar10, cifar100, path, synthetic}
+    mean: Optional[str] = None
+    std: Optional[str] = None
+    data_folder: Optional[str] = None
+    size: int = 32
+    # method (main_supcon.py:58-64)
+    method: str = "SimCLR"  # {SupCon, SimCLR}
+    temp: float = 0.5
+    # other settings (main_supcon.py:66-88)
+    cosine: bool = False
+    syncBN: bool = False
+    warm: bool = False
+    trial: str = "0"
+    sec: bool = False
+    sec_wei: float = 0.0
+    norm_momentum: float = 1.0
+    l2reg: bool = False
+    l2reg_wei: float = 0.0
+    ckpt: str = ""
+    ngpu: int = 2  # grad-scale equivalence divisor (reference --ngpu default)
+    # head (reference hardcodes SupConResNet defaults, resnet_big.py:161)
+    head: str = "mlp"
+    feat_dim: int = 128
+    # --- TPU-native additions ---
+    bf16: bool = False
+    resume: str = ""
+    model_parallel: int = 1
+    seed: int = 0
+    workdir: str = "./work_space"
+    tb_every: int = 10  # per-iter TB cadence (reference logs every iter)
+    # derived (finalize_supcon)
+    warm_epochs: int = 10
+    warmup_from: float = 0.01
+    warmup_to: float = 0.0
+    model_name: str = ""
+    tb_folder: str = ""
+    save_folder: str = ""
+
+
+def _add_bool_flag(parser, name, default=False, help=""):
+    parser.add_argument(f"--{name}", action="store_true", default=default, help=help)
+
+
+def supcon_parser() -> argparse.ArgumentParser:
+    d = SupConConfig()
+    p = argparse.ArgumentParser("argument for training")
+    p.add_argument("--print_freq", type=int, default=d.print_freq)
+    p.add_argument("--save_freq", type=int, default=d.save_freq)
+    p.add_argument("--batch_size", type=int, default=d.batch_size)
+    p.add_argument("--num_workers", type=int, default=d.num_workers)
+    p.add_argument("--epochs", type=int, default=d.epochs)
+    p.add_argument("--learning_rate", type=float, default=d.learning_rate)
+    p.add_argument("--lr_decay_epochs", type=str, default="700,800,900")
+    p.add_argument("--lr_decay_rate", type=float, default=d.lr_decay_rate)
+    p.add_argument("--weight_decay", type=float, default=d.weight_decay)
+    p.add_argument("--momentum", type=float, default=d.momentum)
+    p.add_argument("--model", type=str, default=d.model)
+    p.add_argument("--dataset", type=str, default=d.dataset,
+                   choices=["cifar10", "cifar100", "path", "synthetic"])
+    p.add_argument("--mean", type=str, default=None,
+                   help="mean of dataset in path in form of str tuple")
+    p.add_argument("--std", type=str, default=None)
+    p.add_argument("--data_folder", type=str, default=None)
+    p.add_argument("--size", type=int, default=d.size)
+    p.add_argument("--method", type=str, default=d.method, choices=["SupCon", "SimCLR"])
+    p.add_argument("--temp", type=float, default=d.temp)
+    _add_bool_flag(p, "cosine")
+    _add_bool_flag(p, "syncBN")
+    _add_bool_flag(p, "warm")
+    p.add_argument("--trial", type=str, default=d.trial)
+    _add_bool_flag(p, "sec")
+    p.add_argument("--sec_wei", type=float, default=d.sec_wei)
+    p.add_argument("--norm_momentum", type=float, default=d.norm_momentum)
+    _add_bool_flag(p, "l2reg")
+    p.add_argument("--l2reg_wei", type=float, default=d.l2reg_wei)
+    p.add_argument("--ckpt", type=str, default=d.ckpt)
+    p.add_argument("--ngpu", type=int, default=d.ngpu)
+    p.add_argument("--head", type=str, default=d.head, choices=["mlp", "linear"])
+    p.add_argument("--feat_dim", type=int, default=d.feat_dim)
+    _add_bool_flag(p, "bf16")
+    p.add_argument("--resume", type=str, default=d.resume)
+    p.add_argument("--model_parallel", type=int, default=d.model_parallel)
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--workdir", type=str, default=d.workdir)
+    p.add_argument("--tb_every", type=int, default=d.tb_every)
+    return p
+
+
+def parse_supcon(argv=None) -> SupConConfig:
+    ns = supcon_parser().parse_args(argv)
+    kwargs = vars(ns)
+    kwargs["lr_decay_epochs"] = tuple(int(x) for x in kwargs["lr_decay_epochs"].split(","))
+    cfg = SupConConfig(**kwargs)
+    return finalize_supcon(cfg)
+
+
+def finalize_supcon(cfg: SupConConfig, make_dirs: bool = True) -> SupConConfig:
+    """Derived fields, replicating main_supcon.py:92-150."""
+    if cfg.dataset == "path":
+        assert cfg.data_folder is not None and cfg.mean is not None and cfg.std is not None
+    if cfg.data_folder is None:
+        cfg.data_folder = "./datasets/"
+
+    cfg.model_name = (
+        f"{cfg.method}_{cfg.dataset}_{cfg.model}_lr_{cfg.learning_rate}"
+        f"_decay_{cfg.weight_decay}_bsz_{cfg.batch_size}_temp_{cfg.temp}_trial_{cfg.trial}"
+    )
+    if cfg.cosine:
+        cfg.model_name = f"{cfg.model_name}_cosine"
+    if cfg.sec:
+        cfg.model_name = f"{cfg.model_name}_sec"
+    if cfg.batch_size > 256:
+        cfg.warm = True
+    if cfg.warm:
+        cfg.model_name = f"{cfg.model_name}_warm"
+        cfg.warmup_from = 0.01
+        cfg.warm_epochs = 10
+        cfg.warmup_to = warmup_to_value(
+            cfg.learning_rate, cfg.lr_decay_rate, cfg.warm_epochs, cfg.epochs, cfg.cosine
+        )
+
+    now_time = datetime.datetime.now().strftime("%m%d_%H%M")
+    prefix = f"{cfg.dataset}_{now_time}_"
+    model_path = os.path.join(cfg.workdir, f"{cfg.dataset}_models")
+    tb_path = os.path.join(cfg.workdir, f"{cfg.dataset}_tensorboard")
+    cfg.tb_folder = os.path.join(tb_path, prefix + cfg.model_name)
+    cfg.save_folder = os.path.join(model_path, prefix + cfg.model_name)
+    if make_dirs and is_main_process():
+        os.makedirs(cfg.tb_folder, exist_ok=True)
+        os.makedirs(cfg.save_folder, exist_ok=True)
+    return cfg
+
+
+@dataclasses.dataclass
+class LinearConfig:
+    """Probe config (main_linear.py:21-116); also serves the CE baseline."""
+
+    print_freq: int = 10
+    save_freq: int = 10
+    batch_size: int = 512
+    num_workers: int = 16
+    epochs: int = 100
+    learning_rate: float = 0.1
+    lr_decay_epochs: Tuple[int, ...] = (60, 75, 90)
+    lr_decay_rate: float = 0.2
+    weight_decay: float = 0.0
+    momentum: float = 0.9
+    model: str = "resnet50"
+    dataset: str = "cifar10"  # {cifar10, cifar100, synthetic}
+    cosine: bool = False
+    warm: bool = False
+    ckpt: str = ""
+    # TPU-native additions
+    data_folder: str = "./datasets/"
+    size: int = 32
+    val_batch_size: int = 256  # main_ce.py:64-66
+    bf16: bool = False
+    seed: int = 0
+    workdir: str = "./work_space"
+    trial: str = "0"
+    # derived
+    n_cls: int = 10
+    warm_epochs: int = 10
+    warmup_from: float = 0.01
+    warmup_to: float = 0.0
+    model_name: str = ""
+    tb_folder: str = ""
+    save_folder: str = ""
+
+
+def linear_parser(ce: bool = False) -> argparse.ArgumentParser:
+    d = LinearConfig()
+    p = argparse.ArgumentParser("argument for training")
+    p.add_argument("--print_freq", type=int, default=d.print_freq)
+    p.add_argument("--save_freq", type=int, default=d.save_freq)
+    p.add_argument("--batch_size", type=int, default=d.batch_size)
+    p.add_argument("--num_workers", type=int, default=d.num_workers)
+    p.add_argument("--epochs", type=int, default=d.epochs)
+    p.add_argument("--learning_rate", type=float, default=d.learning_rate)
+    p.add_argument("--lr_decay_epochs", type=str, default="60,75,90")
+    p.add_argument("--lr_decay_rate", type=float, default=d.lr_decay_rate)
+    p.add_argument("--weight_decay", type=float, default=d.weight_decay)
+    p.add_argument("--momentum", type=float, default=d.momentum)
+    p.add_argument("--model", type=str, default=d.model)
+    p.add_argument("--dataset", type=str, default=d.dataset,
+                   choices=["cifar10", "cifar100", "synthetic"])
+    _add_bool_flag(p, "cosine")
+    _add_bool_flag(p, "warm")
+    if not ce:
+        p.add_argument("--ckpt", type=str, default=d.ckpt,
+                       help="path to pre-trained model checkpoint dir")
+    p.add_argument("--data_folder", type=str, default=d.data_folder)
+    p.add_argument("--val_batch_size", type=int, default=d.val_batch_size)
+    _add_bool_flag(p, "bf16")
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--workdir", type=str, default=d.workdir)
+    p.add_argument("--trial", type=str, default=d.trial)
+    return p
+
+
+def parse_linear(argv=None, ce: bool = False) -> LinearConfig:
+    ns = linear_parser(ce=ce).parse_args(argv)
+    kwargs = vars(ns)
+    kwargs["lr_decay_epochs"] = tuple(int(x) for x in kwargs["lr_decay_epochs"].split(","))
+    cfg = LinearConfig(**kwargs)
+    return finalize_linear(cfg, prefix="ce_" if ce else "classifier_")
+
+
+def finalize_linear(
+    cfg: LinearConfig, prefix: str = "classifier_", make_dirs: bool = True
+) -> LinearConfig:
+    """Derived fields, replicating main_linear.py:65-114."""
+    cfg.model_name = (
+        f"{cfg.dataset}_{cfg.model}_lr_{cfg.learning_rate}"
+        f"_decay_{cfg.weight_decay}_bsz_{cfg.batch_size}"
+    )
+    if cfg.cosine:
+        cfg.model_name = f"{cfg.model_name}_cosine"
+    if cfg.warm:
+        cfg.model_name = f"{cfg.model_name}_warm"
+        cfg.warmup_from = 0.01
+        cfg.warm_epochs = 10
+        cfg.warmup_to = warmup_to_value(
+            cfg.learning_rate, cfg.lr_decay_rate, cfg.warm_epochs, cfg.epochs, cfg.cosine
+        )
+    cfg.n_cls = {"cifar10": 10, "cifar100": 100, "synthetic": 10}[cfg.dataset]
+
+    now_time = datetime.datetime.now().strftime("%m%d_%H%M")
+    run = prefix + now_time + "_"
+    cfg.tb_folder = os.path.join(cfg.workdir, f"{cfg.dataset}_tensorboard", run + cfg.model_name)
+    cfg.save_folder = os.path.join(cfg.workdir, f"{cfg.dataset}_models", run + cfg.model_name)
+    if make_dirs and is_main_process():
+        os.makedirs(cfg.tb_folder, exist_ok=True)
+        os.makedirs(cfg.save_folder, exist_ok=True)
+    return cfg
+
+
+def config_dict(cfg) -> dict:
+    """JSON-safe config for checkpoint metadata (unlike the reference, which
+    pickles the whole namespace incl. a live tensor, util.py:89-94)."""
+    out = {}
+    for k, v in dataclasses.asdict(cfg).items():
+        out[k] = list(v) if isinstance(v, tuple) else v
+    return out

@@ -2,27 +2,58 @@
 
 The reference shards the dataset across ranks with ``DistributedSampler`` and
 reshuffles per epoch via ``sampler.set_epoch(epoch)`` (``main_supcon.py:195-199,
-387``), dropping the last partial batch. Here:
+387``), dropping the last partial batch, and hides batch assembly inside an
+8-worker DataLoader pool (``:200-207``). Here:
 
-- one deterministic permutation per epoch (seeded by ``base_seed + epoch``) —
-  identical on every process, so the global batch composition is well-defined;
+- one deterministic numpy permutation per epoch (seeded ``base_seed + epoch``) —
+  identical on every process, so the global batch composition is well-defined
+  across hosts;
 - ``drop_last`` truncation to whole GLOBAL batches (``main_supcon.py:206``);
 - each process slices its contiguous block of every global batch
   (``process_index * per_proc : ... + per_proc``) — the multi-host analogue of
-  per-rank ``batch_size // ngpu`` (``main_supcon.py:202``). Single host = the
-  whole batch. The global array is reassembled on device by
-  ``parallel.mesh.shard_host_batch``.
-
-Augmentation is NOT here — it runs on device (ops/augment.py), so this loader
-only permutes uint8 arrays and hands out views; there is nothing left for a
-worker pool to do (the reference's ``num_workers=8`` host pipeline disappears).
+  per-rank ``batch_size // ngpu`` (``main_supcon.py:202``);
+- batch assembly (uint8 row gather) runs through the native C++ library
+  (``native/gather.cpp``) when available — it releases the GIL, so the
+  ``prefetch`` background thread genuinely overlaps staging of batch k+1 with
+  the device step on batch k. Augmentation itself is NOT here: it runs jitted
+  on device (ops/augment.py), so this is all the host work that remains.
 """
 
 from __future__ import annotations
 
-from typing import Iterator, Tuple
+import ctypes
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
 
 import numpy as np
+
+from simclr_pytorch_distributed_tpu.native.build import load as load_native
+
+
+def _gather(images: np.ndarray, labels: np.ndarray, sel: np.ndarray):
+    """Assemble (images[sel], labels[sel]); native memcpy path when available."""
+    lib = load_native()
+    if lib is None or not images.flags["C_CONTIGUOUS"]:
+        return images[sel], labels[sel]
+    sel = np.ascontiguousarray(sel, np.int64)
+    out_img = np.empty((len(sel),) + images.shape[1:], images.dtype)
+    row_bytes = images.dtype.itemsize * int(np.prod(images.shape[1:]))
+    lib.gather_rows_u8(
+        images.ctypes.data_as(ctypes.c_void_p),
+        sel.ctypes.data_as(ctypes.c_void_p),
+        len(sel), row_bytes,
+        out_img.ctypes.data_as(ctypes.c_void_p),
+    )
+    labels32 = labels if labels.dtype == np.int32 else labels.astype(np.int32)
+    out_lab = np.empty(len(sel), np.int32)
+    lib.gather_rows_i32(
+        labels32.ctypes.data_as(ctypes.c_void_p),
+        sel.ctypes.data_as(ctypes.c_void_p),
+        len(sel),
+        out_lab.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out_img, out_lab
 
 
 class EpochLoader:
@@ -39,20 +70,22 @@ class EpochLoader:
         base_seed: int = 0,
         process_index: int = 0,
         process_count: int = 1,
+        prefetch: int = 2,
     ):
         if global_batch_size % process_count != 0:
             raise ValueError(
                 f"global batch {global_batch_size} not divisible by "
                 f"{process_count} processes"
             )
-        self.images = images
-        self.labels = labels
+        self.images = np.ascontiguousarray(images)
+        self.labels = np.ascontiguousarray(labels)
         self.global_batch_size = global_batch_size
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.base_seed = base_seed
         self.process_index = process_index
         self.process_count = process_count
+        self.prefetch = prefetch
         n = len(images)
         if drop_last:
             self.steps_per_epoch = n // global_batch_size
@@ -64,19 +97,47 @@ class EpochLoader:
                 f"({global_batch_size})"
             )
 
-    def epoch(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        """One pass; ``epoch`` seeds the shuffle (sampler.set_epoch equivalent)."""
+    def _epoch_order(self, epoch: int) -> np.ndarray:
         n = len(self.images)
         if self.shuffle:
-            order = np.random.default_rng(self.base_seed + epoch).permutation(n)
-        else:
-            order = np.arange(n)
+            return np.random.default_rng(self.base_seed + epoch).permutation(n)
+        return np.arange(n)
+
+    def _batches(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        order = self._epoch_order(epoch)
         per_proc = self.global_batch_size // self.process_count
         lo = self.process_index * per_proc
         for step in range(self.steps_per_epoch):
             sel = order[step * self.global_batch_size:(step + 1) * self.global_batch_size]
             sel = sel[lo:lo + per_proc]
-            yield self.images[sel], self.labels[sel]
+            yield _gather(self.images, self.labels, sel)
+
+    def epoch(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """One pass; ``epoch`` seeds the shuffle (sampler.set_epoch equivalent).
+
+        With ``prefetch > 0``, batch assembly runs in a daemon thread so the
+        native gather for step k+1 overlaps the device step for batch k.
+        """
+        if self.prefetch <= 0:
+            yield from self._batches(epoch)
+            return
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        sentinel = object()
+
+        def worker():
+            for item in self._batches(epoch):
+                q.put(item)
+            q.put(sentinel)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        t.join()
 
     def __len__(self) -> int:
         return self.steps_per_epoch

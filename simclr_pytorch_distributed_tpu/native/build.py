@@ -1,0 +1,62 @@
+"""Build + load the native staging library (ctypes, no pip/pybind needed).
+
+Compiled once per machine into the package dir; falls back to None (callers use
+numpy paths) if no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "gather.cpp")
+_LIB = os.path.join(_DIR, "libsptpu_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as e:  # toolchain missing / sandboxed
+        logging.info("native staging lib unavailable (%s); using numpy paths", e)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Returns the loaded library or None. Thread-safe, compiles on first use."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("SPTPU_NATIVE", "1") == "0":
+            return None
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _compile():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            logging.info("failed to load native lib: %s", e)
+            return None
+        lib.gather_rows_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p,
+        ]
+        lib.gather_rows_i32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        lib.epoch_permutation.argtypes = [
+            ctypes.c_int64, ctypes.c_uint64, ctypes.c_void_p,
+        ]
+        _lib = lib
+        return _lib

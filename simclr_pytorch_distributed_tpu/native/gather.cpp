@@ -1,0 +1,56 @@
+// Native host-side batch staging for the data pipeline.
+//
+// The reference hides its host data path inside torch's C-accelerated
+// DataLoader worker pool (8 workers + pinned memory, main_supcon.py:200-207).
+// Our host work is far smaller — augmentation happens on device — but the one
+// hot host op left is assembling a uint8 batch from a shuffled index set every
+// step. This library does that gather in C++ (memcpy per row, no Python object
+// overhead) and, crucially, releases the GIL so a prefetch thread overlaps
+// batch assembly with the device step (see data/pipeline.py).
+//
+// Built on demand with g++ -O3 -shared -fPIC (see native/build.py); loaded via
+// ctypes. Pure C ABI, no Python headers needed.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// dst[i, :] = src[idx[i], :] for row_bytes-sized rows.
+void gather_rows_u8(const uint8_t* src, const int64_t* idx, int64_t n_idx,
+                    int64_t row_bytes, uint8_t* dst) {
+  for (int64_t i = 0; i < n_idx; ++i) {
+    std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes,
+                static_cast<size_t>(row_bytes));
+  }
+}
+
+// int32 label gather (labels are 4-byte scalars).
+void gather_rows_i32(const int32_t* src, const int64_t* idx, int64_t n_idx,
+                     int32_t* dst) {
+  for (int64_t i = 0; i < n_idx; ++i) {
+    dst[i] = src[idx[i]];
+  }
+}
+
+// Fisher-Yates with SplitMix64: deterministic epoch permutation without
+// numpy's RNG overhead. Seeds match data/pipeline.py's base_seed + epoch.
+static inline uint64_t splitmix64(uint64_t* s) {
+  uint64_t z = (*s += 0x9e3779b97f4a7c15ull);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+  return z ^ (z >> 31);
+}
+
+void epoch_permutation(int64_t n, uint64_t seed, int64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  uint64_t s = seed;
+  for (int64_t i = n - 1; i > 0; --i) {
+    int64_t j = static_cast<int64_t>(splitmix64(&s) % static_cast<uint64_t>(i + 1));
+    int64_t t = out[i];
+    out[i] = out[j];
+    out[j] = t;
+  }
+}
+
+}  // extern "C"

@@ -18,6 +18,7 @@ runtime is a single SPMD program:
 from __future__ import annotations
 
 import logging
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -61,7 +62,14 @@ def create_mesh(
     axis_names: Sequence[str] = (DATA_AXIS, MODEL_AXIS),
 ) -> Mesh:
     """Build a (data, model) mesh over all devices; model axis defaults to 1."""
-    devices = list(devices if devices is not None else jax.devices())
+    if devices is None:
+        devices = jax.devices()
+        # test/debug hook: cap mesh size (GSPMD partitioning cost on the
+        # single-core CPU test host scales with partition count)
+        limit = int(os.environ.get("SPTPU_MAX_DEVICES", "0"))
+        if limit:
+            devices = devices[:limit]
+    devices = list(devices)
     n = len(devices)
     if n % model_parallel != 0:
         raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")

@@ -1,0 +1,217 @@
+"""Supervised cross-entropy baseline trainer — the trainer main_ce.py LOST.
+
+The reference fork kept only ``set_loader`` of main_ce.py (``main_ce.py:19-68``);
+``SupCEResNet`` is imported but never trained (SURVEY.md §2.1 #14). BASELINE.json
+still lists the CE-baseline config, so this rebuilds the complete trainer:
+SupCEResNet end-to-end with the probe stage's aug stack (RRC+flip, main_ce.py:
+31-36), SGD + the shared schedule machinery, top-1/5 validation, best-acc
+tracking — distributed over the mesh like the contrastive stage.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from simclr_pytorch_distributed_tpu import config as config_lib
+from simclr_pytorch_distributed_tpu.data.cifar import load_dataset
+from simclr_pytorch_distributed_tpu.data.pipeline import EpochLoader
+from simclr_pytorch_distributed_tpu.models import SupCEResNet
+from simclr_pytorch_distributed_tpu.ops.augment import (
+    AugmentConfig,
+    augment_batch,
+    eval_batch,
+)
+from simclr_pytorch_distributed_tpu.ops.losses import cross_entropy_loss
+from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
+from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
+from simclr_pytorch_distributed_tpu.parallel.mesh import (
+    batch_sharding,
+    create_mesh,
+    is_main_process,
+    replicated_sharding,
+    setup_distributed,
+    shard_host_batch,
+)
+from simclr_pytorch_distributed_tpu.train.linear import run_validation, stats_for, topk_correct
+from simclr_pytorch_distributed_tpu.utils.checkpoint import save_checkpoint
+from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
+
+
+class CEState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+
+def make_ce_steps(model, tx, aug_cfg, mesh):
+    repl = replicated_sharding(mesh)
+
+    def train_step(state: CEState, images_u8, labels, key):
+        images = augment_batch(key, images_u8, aug_cfg)
+
+        def loss_fn(params):
+            logits, mutated = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                images, train=True, mutable=["batch_stats"],
+            )
+            return cross_entropy_loss(logits.astype(jnp.float32), labels), (logits, mutated)
+
+        (loss, (logits, mutated)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_state = CEState(
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            batch_stats=mutated["batch_stats"],
+            opt_state=new_opt,
+        )
+        correct = topk_correct(logits, labels)
+        return new_state, {"loss": loss, "top1": correct[1], "top5": correct[5]}
+
+    def eval_step(state_vars, images_u8, labels, valid):
+        images = eval_batch(images_u8, aug_cfg)
+        logits = model.apply(
+            {"params": state_vars["params"], "batch_stats": state_vars["batch_stats"]},
+            images, train=False,
+        ).astype(jnp.float32)
+        per_ex = -jax.nn.log_softmax(logits)[jnp.arange(labels.shape[0]), labels]
+        hit = jax.lax.top_k(logits, 5)[1] == labels[:, None]
+        return {
+            "loss_sum": jnp.sum(per_ex * valid),
+            "top1": jnp.sum(jnp.any(hit[:, :1], axis=1) * valid),
+            "top5": jnp.sum(jnp.any(hit, axis=1) * valid),
+            "n": jnp.sum(valid),
+        }
+
+    train_jit = jax.jit(
+        train_step,
+        in_shardings=(repl, batch_sharding(mesh, 4), batch_sharding(mesh, 1), repl),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+    eval_jit = jax.jit(
+        eval_step,
+        in_shardings=(repl, batch_sharding(mesh, 4), batch_sharding(mesh, 1),
+                      batch_sharding(mesh, 1)),
+        out_shardings=repl,
+    )
+    return train_jit, eval_jit
+
+
+def run(cfg: config_lib.LinearConfig):
+    setup_distributed()
+    setup_logging(cfg.save_folder, is_main_process())
+    mesh = create_mesh()
+
+    train_data, test_data, n_cls = load_dataset(
+        cfg.dataset, cfg.data_folder,
+        allow_synthetic_fallback=(cfg.dataset == "synthetic"),
+    )
+    cfg.n_cls = n_cls
+    loader = EpochLoader(
+        train_data["images"], train_data["labels"], cfg.batch_size,
+        base_seed=cfg.seed, process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
+    steps_per_epoch = len(loader)
+
+    dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
+    model = SupCEResNet(model_name=cfg.model, num_classes=n_cls, dtype=dtype)
+    schedule = make_lr_schedule(
+        learning_rate=cfg.learning_rate, epochs=cfg.epochs,
+        steps_per_epoch=steps_per_epoch, cosine=cfg.cosine,
+        lr_decay_rate=cfg.lr_decay_rate, lr_decay_epochs=cfg.lr_decay_epochs,
+        warm=cfg.warm, warm_epochs=cfg.warm_epochs, warmup_from=cfg.warmup_from,
+    )
+    from simclr_pytorch_distributed_tpu.train.state import make_optimizer
+
+    tx = make_optimizer(schedule, momentum=cfg.momentum, weight_decay=cfg.weight_decay)
+    variables = model.init(
+        jax.random.key(cfg.seed), jnp.zeros((2, cfg.size, cfg.size, 3)), train=True
+    )
+    state = CEState(
+        step=jnp.zeros((), jnp.int32),
+        params=variables["params"],
+        batch_stats=variables["batch_stats"],
+        opt_state=tx.init(variables["params"]),
+    )
+
+    mean, std = stats_for(cfg.dataset)
+    aug_cfg = AugmentConfig(size=cfg.size, mean=mean, std=std, color_ops=False)
+    train_jit, eval_jit = make_ce_steps(model, tx, aug_cfg, mesh)
+
+    tb = TBLogger(cfg.tb_folder, enabled=is_main_process())
+    base_key = jax.random.key(cfg.seed + 1)
+    best_acc, best_acc5 = 0.0, 0.0
+
+    def eval_variables(state):
+        return {"params": state.params, "batch_stats": state.batch_stats}
+
+    for epoch in range(1, cfg.epochs + 1):
+        t1 = time.time()
+        losses, top1 = AverageMeter(), AverageMeter()
+        for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
+            key = jax.random.fold_in(base_key, (epoch - 1) * steps_per_epoch + idx)
+            batch = shard_host_batch((images_u8, labels), mesh)
+            state, m = train_jit(state, batch[0], batch[1], key)
+            if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
+                losses.update(float(m["loss"]), cfg.batch_size)
+                top1.update(100.0 * float(m["top1"]) / cfg.batch_size, cfg.batch_size)
+                logging.info(
+                    "Train: [%d][%d/%d]\tloss %.3f (%.3f)\tAcc@1 %.3f (%.3f)",
+                    epoch, idx + 1, steps_per_epoch,
+                    losses.val, losses.avg, top1.val, top1.avg,
+                )
+        logging.info("Train epoch %d, total time %.2f, accuracy:%.2f",
+                     epoch, time.time() - t1, top1.avg)
+
+        val = run_validation(
+            eval_jit, eval_variables(state), test_data["images"],
+            test_data["labels"], cfg.val_batch_size, mesh,
+        )
+        logging.info(" * Acc@1 %.3f, Acc@5 %.3f", val["top1"], val["top5"])
+        if is_main_process():
+            tb.log_value("ce/train_loss", losses.avg, epoch)
+            tb.log_value("ce/train_acc1", top1.avg, epoch)
+            tb.log_value("ce/val_loss", val["loss"], epoch)
+            tb.log_value("ce/val_acc1", val["top1"], epoch)
+            tb.log_value("ce/val_acc5", val["top5"], epoch)
+        if val["top1"] > best_acc:
+            best_acc, best_acc5 = val["top1"], val["top5"]
+        if is_main_process() and epoch % cfg.save_freq == 0:
+            save_checkpoint(
+                cfg.save_folder, f"ckpt_epoch_{epoch}",
+                # CEState quacks enough like TrainState for the saver
+                state_for_save(state), config=config_lib.config_dict(cfg), epoch=epoch,
+            )
+
+    logging.info("best accuracy: %.2f, accuracy5: %.2f", best_acc, best_acc5)
+    tb.close()
+    return best_acc, best_acc5
+
+
+def state_for_save(state: CEState):
+    from simclr_pytorch_distributed_tpu.train.state import TrainState
+
+    return TrainState(
+        step=state.step, params=state.params, batch_stats=state.batch_stats,
+        opt_state=state.opt_state, record_norm_mean=jnp.zeros((), jnp.float32),
+    )
+
+
+def main(argv=None):
+    cfg = config_lib.parse_linear(argv, ce=True)
+    run(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,284 @@
+"""Linear-probe evaluation driver — main_linear.py, TPU-native.
+
+Semantics from the reference (SURVEY.md §3.4):
+
+- the pretrained encoder is FROZEN and in eval mode: BN uses running statistics
+  and nothing updates (``model.eval()`` + ``torch.no_grad()`` + ``.detach()``,
+  ``main_linear.py:149,170-172``) — here the encoder runs ``train=False`` under
+  ``stop_gradient`` and only classifier params are in the optimizer;
+- train aug is RRC(0.2-1)+flip only, val is normalize only
+  (``main_ce.py:31-41`` via ``main_linear.py:12,253``);
+- SGD on the classifier with step decay 60/75/90 x0.2 by default, 100 epochs;
+  top-1/top-5 tracked, best val acc reported at the end
+  (``main_linear.py:284-288``) — the number the README tables quote.
+
+The probe runs data-parallel over the mesh (the reference is single-GPU; here
+extra chips just shard the batch — the math is identical because the encoder is
+frozen and CE is a per-example mean).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from simclr_pytorch_distributed_tpu import config as config_lib
+from simclr_pytorch_distributed_tpu.data.cifar import load_dataset
+from simclr_pytorch_distributed_tpu.data.pipeline import EpochLoader
+from simclr_pytorch_distributed_tpu.models import LinearClassifier, SupConResNet
+from simclr_pytorch_distributed_tpu.ops.augment import (
+    DATASET_STATS,
+    AugmentConfig,
+    augment_batch,
+    eval_batch,
+)
+from simclr_pytorch_distributed_tpu.ops.losses import cross_entropy_loss
+from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
+from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
+from simclr_pytorch_distributed_tpu.parallel.mesh import (
+    batch_sharding,
+    create_mesh,
+    is_main_process,
+    replicated_sharding,
+    setup_distributed,
+    shard_host_batch,
+)
+from simclr_pytorch_distributed_tpu.train.state import make_optimizer
+from simclr_pytorch_distributed_tpu.utils.checkpoint import load_pretrained_variables
+from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
+
+
+class ProbeState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any  # classifier params only
+    opt_state: Any
+
+
+def stats_for(dataset: str) -> Tuple[Tuple[float, ...], Tuple[float, ...]]:
+    if dataset in DATASET_STATS:
+        return DATASET_STATS[dataset]
+    return ((0.5, 0.5, 0.5), (0.25, 0.25, 0.25))  # synthetic
+
+
+def build_probe(cfg: config_lib.LinearConfig, steps_per_epoch: int, encoder_variables):
+    dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
+    encoder = SupConResNet(model_name=cfg.model, dtype=dtype)
+    classifier = LinearClassifier(model_name=cfg.model, num_classes=cfg.n_cls)
+    schedule = make_lr_schedule(
+        learning_rate=cfg.learning_rate, epochs=cfg.epochs,
+        steps_per_epoch=steps_per_epoch, cosine=cfg.cosine,
+        lr_decay_rate=cfg.lr_decay_rate, lr_decay_epochs=cfg.lr_decay_epochs,
+        warm=cfg.warm, warm_epochs=cfg.warm_epochs, warmup_from=cfg.warmup_from,
+    )
+    tx = make_optimizer(schedule, momentum=cfg.momentum, weight_decay=cfg.weight_decay)
+    feat_dim = {"resnet18": 512, "resnet34": 512}.get(cfg.model, 2048)
+    cls_params = classifier.init(
+        jax.random.key(cfg.seed), jnp.zeros((2, feat_dim))
+    )["params"]
+    state = ProbeState(
+        step=jnp.zeros((), jnp.int32), params=cls_params, opt_state=tx.init(cls_params)
+    )
+
+    def encode(images):
+        feats = encoder.apply(
+            {"params": encoder_variables["params"],
+             "batch_stats": encoder_variables["batch_stats"]},
+            images, train=False, method=SupConResNet.encode,
+        )
+        return jax.lax.stop_gradient(feats.astype(jnp.float32))
+
+    return encoder, classifier, schedule, tx, state, encode
+
+
+def topk_correct(logits: jax.Array, labels: jax.Array, ks=(1, 5)):
+    """Per-batch top-k correct counts (sum-able across shards/batches)."""
+    maxk = max(ks)
+    _, pred = jax.lax.top_k(logits, maxk)
+    hit = pred == labels[:, None]
+    return {k: jnp.sum(jnp.any(hit[:, :k], axis=1)) for k in ks}
+
+
+def make_probe_steps(classifier, tx, encode, aug_cfg, eval_cfg, mesh):
+    repl = replicated_sharding(mesh)
+
+    def train_step(state: ProbeState, images_u8, labels, key):
+        images = augment_batch(key, images_u8, aug_cfg)
+
+        def loss_fn(params):
+            logits = classifier.apply({"params": params}, encode(images))
+            return cross_entropy_loss(logits, labels), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_state = ProbeState(
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            opt_state=new_opt,
+        )
+        correct = topk_correct(logits, labels)
+        metrics = {"loss": loss, "top1": correct[1], "top5": correct[5]}
+        return new_state, metrics
+
+    def eval_step(params, images_u8, labels, valid):
+        images = eval_batch(images_u8, eval_cfg)
+        logits = classifier.apply({"params": params}, encode(images))
+        per_ex = -jax.nn.log_softmax(logits)[jnp.arange(labels.shape[0]), labels]
+        loss_sum = jnp.sum(per_ex * valid)
+        maxk_hit = jax.lax.top_k(logits, 5)[1] == labels[:, None]
+        top1 = jnp.sum(jnp.any(maxk_hit[:, :1], axis=1) * valid)
+        top5 = jnp.sum(jnp.any(maxk_hit, axis=1) * valid)
+        return {"loss_sum": loss_sum, "top1": top1, "top5": top5, "n": jnp.sum(valid)}
+
+    train_jit = jax.jit(
+        train_step,
+        in_shardings=(repl, batch_sharding(mesh, 4), batch_sharding(mesh, 1), repl),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+    eval_jit = jax.jit(
+        eval_step,
+        in_shardings=(repl, batch_sharding(mesh, 4), batch_sharding(mesh, 1),
+                      batch_sharding(mesh, 1)),
+        out_shardings=repl,
+    )
+    return train_jit, eval_jit
+
+
+def run_validation(eval_jit, params, val_images, val_labels, batch_size, mesh):
+    """Full-val top-1/top-5 (reference validate(), main_linear.py:204-244).
+
+    The tail batch is padded to a static shape and masked so the jit never
+    recompiles; every example counts exactly once.
+    """
+    n = len(val_images)
+    totals = {"loss_sum": 0.0, "top1": 0.0, "top5": 0.0, "n": 0.0}
+    for lo in range(0, n, batch_size):
+        chunk_img = val_images[lo:lo + batch_size]
+        chunk_lab = val_labels[lo:lo + batch_size]
+        valid = np.ones(len(chunk_img), np.float32)
+        pad = batch_size - len(chunk_img)
+        if pad:
+            chunk_img = np.concatenate([chunk_img, np.repeat(chunk_img[:1], pad, 0)])
+            chunk_lab = np.concatenate([chunk_lab, np.repeat(chunk_lab[:1], pad)])
+            valid = np.concatenate([valid, np.zeros(pad, np.float32)])
+        batch = shard_host_batch((chunk_img, chunk_lab, valid), mesh)
+        m = eval_jit(params, *batch)
+        for k in totals:
+            totals[k] += float(m[k])
+    return {
+        "loss": totals["loss_sum"] / totals["n"],
+        "top1": 100.0 * totals["top1"] / totals["n"],
+        "top5": 100.0 * totals["top5"] / totals["n"],
+    }
+
+
+def run(cfg: config_lib.LinearConfig):
+    setup_distributed()
+    setup_logging(cfg.save_folder, is_main_process())
+    mesh = create_mesh()
+
+    train_data, test_data, n_cls = load_dataset(
+        cfg.dataset, cfg.data_folder,
+        allow_synthetic_fallback=(cfg.dataset == "synthetic"),
+    )
+    cfg.n_cls = n_cls
+    loader = EpochLoader(
+        train_data["images"], train_data["labels"], cfg.batch_size,
+        base_seed=cfg.seed, process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
+    steps_per_epoch = len(loader)
+
+    # encoder variables from the pretrain checkpoint (main_linear.py:125-142)
+    dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
+    enc_model = SupConResNet(model_name=cfg.model, dtype=dtype)
+    abstract = enc_model.init(
+        jax.random.key(0), jnp.zeros((2, cfg.size, cfg.size, 3)), train=False
+    )
+    if cfg.ckpt:
+        encoder_variables = load_pretrained_variables(
+            cfg.ckpt, {"params": abstract["params"], "batch_stats": abstract["batch_stats"]}
+        )
+        logging.info("loaded encoder from %s", cfg.ckpt)
+    else:
+        logging.warning("--ckpt not given: probing a RANDOM encoder")
+        encoder_variables = {
+            "params": abstract["params"], "batch_stats": abstract["batch_stats"]
+        }
+
+    _, classifier, schedule, tx, state, encode = build_probe(
+        cfg, steps_per_epoch, encoder_variables
+    )
+    mean, std = stats_for(cfg.dataset)
+    aug_cfg = AugmentConfig(size=cfg.size, mean=mean, std=std, color_ops=False)
+    train_jit, eval_jit = make_probe_steps(
+        classifier, tx, encode, aug_cfg, aug_cfg, mesh
+    )
+
+    tb = TBLogger(cfg.tb_folder, enabled=is_main_process())
+    base_key = jax.random.key(cfg.seed + 1)
+    best_acc, best_acc5 = 0.0, 0.0
+
+    for epoch in range(1, cfg.epochs + 1):
+        t1 = time.time()
+        losses, top1, top5 = AverageMeter(), AverageMeter(), AverageMeter()
+        bt = AverageMeter()
+        end = time.time()
+        for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
+            key = jax.random.fold_in(base_key, (epoch - 1) * steps_per_epoch + idx)
+            batch = shard_host_batch((images_u8, labels), mesh)
+            state, m = train_jit(state, batch[0], batch[1], key)
+            if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
+                bsz = cfg.batch_size
+                losses.update(float(m["loss"]), bsz)
+                top1.update(100.0 * float(m["top1"]) / bsz, bsz)
+                top5.update(100.0 * float(m["top5"]) / bsz, bsz)
+                bt.update(time.time() - end)
+                logging.info(
+                    "Train: [%d][%d/%d]\tBT %.3f (%.3f)\tloss %.3f (%.3f)\t"
+                    "Acc@1 %.3f (%.3f)",
+                    epoch, idx + 1, steps_per_epoch, bt.val, bt.avg,
+                    losses.val, losses.avg, top1.val, top1.avg,
+                )
+            end = time.time()
+        logging.info(
+            "Train epoch %d, total time %.2f, accuracy:%.2f",
+            epoch, time.time() - t1, top1.avg,
+        )
+        if is_main_process():
+            tb.log_value("classifier/train_loss", losses.avg, epoch)
+            tb.log_value("classifier/train_acc1", top1.avg, epoch)
+            tb.log_value("classifier/train_acc5", top5.avg, epoch)
+
+        val = run_validation(
+            eval_jit, state.params, test_data["images"], test_data["labels"],
+            cfg.val_batch_size, mesh,
+        )
+        logging.info(" * Acc@1 %.3f, Acc@5 %.3f", val["top1"], val["top5"])
+        if is_main_process():
+            tb.log_value("classifier/val_loss", val["loss"], epoch)
+            tb.log_value("classifier/val_acc1", val["top1"], epoch)
+            tb.log_value("classifier/val_acc5", val["top5"], epoch)
+        if val["top1"] > best_acc:
+            best_acc, best_acc5 = val["top1"], val["top5"]
+
+    logging.info("best accuracy: %.2f, accuracy5: %.2f", best_acc, best_acc5)
+    tb.close()
+    return best_acc, best_acc5
+
+
+def main(argv=None):
+    cfg = config_lib.parse_linear(argv)
+    run(cfg)
+
+
+if __name__ == "__main__":
+    main()

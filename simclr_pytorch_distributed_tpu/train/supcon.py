@@ -1,0 +1,231 @@
+"""Distributed contrastive pretraining driver — main_supcon.py, TPU-native.
+
+One process per host drives the SPMD program: build mesh -> data -> model/state
+-> jit(augment+step over the mesh) -> epoch loop with meters/TB/checkpoints.
+The reference call stack being replaced is SURVEY.md §3.1/§3.2.
+
+Perf notes vs the reference hot loop:
+- augmentation + forward + loss + update is ONE compiled program per step; the
+  host only permutes uint8 indices (no worker pool, no PIL, no pinned-memory
+  staging);
+- metrics are fetched every ``print_freq`` steps instead of every step, keeping
+  XLA's async dispatch pipeline full (the reference's per-iter ``loss.item()``
+  is a sync point, ``main_supcon.py:320``);
+- checkpoint RESUME is supported (``--resume``), which the reference lacks.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+
+from simclr_pytorch_distributed_tpu import config as config_lib
+from simclr_pytorch_distributed_tpu.data.cifar import load_dataset
+from simclr_pytorch_distributed_tpu.data.pipeline import EpochLoader
+from simclr_pytorch_distributed_tpu.models import SupConResNet
+from simclr_pytorch_distributed_tpu.ops.augment import (
+    DATASET_STATS,
+    AugmentConfig,
+    two_crop_batch,
+)
+from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
+from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
+from simclr_pytorch_distributed_tpu.parallel.mesh import (
+    batch_sharding,
+    create_mesh,
+    is_main_process,
+    replicated_sharding,
+    setup_distributed,
+    shard_host_batch,
+)
+from simclr_pytorch_distributed_tpu.train.state import (
+    TrainState,
+    create_train_state,
+    make_optimizer,
+)
+from simclr_pytorch_distributed_tpu.train.supcon_step import (
+    SupConStepConfig,
+    make_train_step,
+)
+from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+    load_pretrained_variables,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
+
+
+def make_augment_config(cfg: config_lib.SupConConfig, color_ops: bool = True) -> AugmentConfig:
+    if cfg.dataset in DATASET_STATS:
+        mean, std = DATASET_STATS[cfg.dataset]
+    elif cfg.dataset == "synthetic":
+        mean, std = ((0.5, 0.5, 0.5), (0.25, 0.25, 0.25))
+    else:  # 'path' datasets: user-supplied strings (reference main_supcon.py:163-165,
+        # minus its std=eval(mean) bug)
+        mean = tuple(float(x) for x in cfg.mean.strip("()").split(","))
+        std = tuple(float(x) for x in cfg.std.strip("()").split(","))
+    return AugmentConfig(size=cfg.size, mean=mean, std=std, color_ops=color_ops)
+
+
+def build(cfg: config_lib.SupConConfig, steps_per_epoch: int):
+    """Model, schedule, optimizer, initial state, and the fused jitted update."""
+    dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
+    model = SupConResNet(
+        model_name=cfg.model, head=cfg.head, feat_dim=cfg.feat_dim,
+        dtype=dtype, sync_bn=cfg.syncBN,
+    )
+    schedule = make_lr_schedule(
+        learning_rate=cfg.learning_rate, epochs=cfg.epochs,
+        steps_per_epoch=steps_per_epoch, cosine=cfg.cosine,
+        lr_decay_rate=cfg.lr_decay_rate, lr_decay_epochs=cfg.lr_decay_epochs,
+        warm=cfg.warm, warm_epochs=cfg.warm_epochs, warmup_from=cfg.warmup_from,
+    )
+    tx = make_optimizer(schedule, momentum=cfg.momentum, weight_decay=cfg.weight_decay)
+    state = create_train_state(
+        model, tx, jax.random.key(cfg.seed),
+        jnp.zeros((2, cfg.size, cfg.size, 3), jnp.float32),
+    )
+    step_cfg = SupConStepConfig(
+        method=cfg.method, temperature=cfg.temp,
+        sec=cfg.sec, sec_wei=cfg.sec_wei, l2reg=cfg.l2reg, l2reg_wei=cfg.l2reg_wei,
+        norm_momentum=cfg.norm_momentum, epochs=cfg.epochs,
+        steps_per_epoch=steps_per_epoch, grad_div=float(cfg.ngpu),
+    )
+    return model, schedule, tx, state, step_cfg
+
+
+def make_fused_update(model, tx, schedule, step_cfg, aug_cfg, mesh, state_example):
+    """augment(two crops) + train step as one GSPMD program."""
+    train_step = make_train_step(model, tx, schedule, step_cfg)
+
+    def update(state: TrainState, images_u8, labels, key):
+        views = two_crop_batch(key, images_u8, aug_cfg)
+        return train_step(state, views, labels)
+
+    repl = replicated_sharding(mesh)
+    state_sh = jax.tree.map(lambda _: repl, state_example)
+    return jax.jit(
+        update,
+        in_shardings=(state_sh, batch_sharding(mesh, 4), batch_sharding(mesh, 1), repl),
+        out_shardings=(state_sh, repl),
+        donate_argnums=(0,),
+    )
+
+
+def train_one_epoch(
+    epoch, loader, update_fn, state, mesh, base_key, cfg, tb, steps_per_epoch
+):
+    """One epoch (reference train(), main_supcon.py:242-351)."""
+    batch_time, data_time, losses = AverageMeter(), AverageMeter(), AverageMeter()
+    end = time.time()
+    pending = None  # (idx, metrics) fetched lazily to keep dispatch async
+    bsz = cfg.batch_size
+
+    for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
+        data_time.update(time.time() - end)
+        global_step = (epoch - 1) * steps_per_epoch + idx
+        key = jax.random.fold_in(base_key, global_step)
+        batch = shard_host_batch((images_u8, labels), mesh)
+        state, metrics = update_fn(state, batch[0], batch[1], key)
+        pending = (idx, global_step, metrics)
+
+        if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
+            idx_f, gstep_f, m = pending
+            m = {k: float(v) for k, v in m.items()}  # device sync point
+            losses.update(m["loss"], bsz)
+            if is_main_process() and tb is not None:
+                # per-iter scalars (reference main_supcon.py:327-333)
+                it = epoch * steps_per_epoch + idx_f
+                tb.log_value("info/norm_mean", m["norm_mean"], it)
+                tb.log_value("info/norm_var", m["norm_var"], it)
+                tb.log_value("info/record_norm_mean", m["record_norm_mean"], it)
+                tb.log_value("info/loss_sec", m["loss_sec"], it)
+                tb.log_value("info/loss_l2reg", m["loss_l2reg"], it)
+            batch_time.update(time.time() - end)
+            logging.info(
+                "Train: [%d][%d/%d]\tBT %.3f (%.3f)\tDT %.3f (%.3f)\t"
+                "loss %.3f (%.3f)\tnorm_mean %.3f (record: %.3f) var %.3f",
+                epoch, idx + 1, steps_per_epoch, batch_time.val, batch_time.avg,
+                data_time.val, data_time.avg, losses.val, losses.avg,
+                m["norm_mean"], m["record_norm_mean"], m["norm_var"],
+            )
+        else:
+            batch_time.update(time.time() - end)
+        end = time.time()
+
+    last_metrics = {k: float(v) for k, v in pending[2].items()} if pending else {}
+    return state, losses.avg if losses.count else last_metrics.get("loss", 0.0), last_metrics
+
+
+def run(cfg: config_lib.SupConConfig) -> TrainState:
+    setup_distributed()
+    setup_logging(cfg.save_folder, is_main_process())
+    mesh = create_mesh(model_parallel=cfg.model_parallel)
+    logging.info("mesh: %s over %d devices", dict(mesh.shape), mesh.size)
+
+    train_data, _, _ = load_dataset(
+        cfg.dataset if cfg.dataset != "path" else "synthetic",
+        cfg.data_folder, allow_synthetic_fallback=(cfg.dataset == "synthetic"),
+    )
+    loader = EpochLoader(
+        train_data["images"], train_data["labels"], cfg.batch_size,
+        base_seed=cfg.seed, process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
+    steps_per_epoch = len(loader)
+    model, schedule, tx, state, step_cfg = build(cfg, steps_per_epoch)
+
+    start_epoch = 1
+    if cfg.ckpt:
+        # warm start: model variables only (main_supcon.py:216-220)
+        variables = load_pretrained_variables(
+            cfg.ckpt, {"params": state.params, "batch_stats": state.batch_stats}
+        )
+        state = state.replace(
+            params=variables["params"], batch_stats=variables["batch_stats"]
+        )
+        logging.info("load model from %s ...", cfg.ckpt)
+    if cfg.resume:
+        state, meta = restore_checkpoint(cfg.resume, state)
+        start_epoch = int(meta.get("epoch", 0)) + 1
+        logging.info("resumed from %s at epoch %d", cfg.resume, start_epoch)
+
+    aug_cfg = make_augment_config(cfg)
+    update_fn = make_fused_update(model, tx, schedule, step_cfg, aug_cfg, mesh, state)
+    tb = TBLogger(cfg.tb_folder, enabled=is_main_process())
+    base_key = jax.random.key(cfg.seed + 1)
+
+    for epoch in range(start_epoch, cfg.epochs + 1):
+        t1 = time.time()
+        state, loss_avg, metrics = train_one_epoch(
+            epoch, loader, update_fn, state, mesh, base_key, cfg, tb, steps_per_epoch
+        )
+        t2 = time.time()
+        logging.info("epoch %d, total time %.2f", epoch, t2 - t1)
+        if is_main_process():
+            tb.log_value("loss", loss_avg, epoch)
+            tb.log_value("learning_rate", float(schedule((epoch - 1) * steps_per_epoch)), epoch)
+            if epoch % cfg.save_freq == 0:
+                save_checkpoint(
+                    cfg.save_folder, f"ckpt_epoch_{epoch}", state,
+                    config=config_lib.config_dict(cfg), epoch=epoch,
+                )
+    if is_main_process():
+        save_checkpoint(
+            cfg.save_folder, "last", state,
+            config=config_lib.config_dict(cfg), epoch=cfg.epochs,
+        )
+    tb.close()
+    return state
+
+
+def main(argv=None):
+    cfg = config_lib.parse_supcon(argv)
+    run(cfg)
+
+
+if __name__ == "__main__":
+    main()

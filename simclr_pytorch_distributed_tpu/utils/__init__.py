@@ -1,0 +1,9 @@
+from simclr_pytorch_distributed_tpu.utils.checkpoint import (  # noqa: F401
+    load_pretrained_variables,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from simclr_pytorch_distributed_tpu.utils.logging_utils import (  # noqa: F401
+    TBLogger,
+    setup_logging,
+)

@@ -1,0 +1,63 @@
+"""Logging + TensorBoard, process-0 gated.
+
+Mirrors the reference's three channels (SURVEY.md §5 observability row):
+
+- python ``logging`` with a formatted stream handler (``util.py:98-105``) and a
+  rank-0 ``log-ing`` file in the save folder (``util.py:108-114`` — whose
+  undefined-``root_path`` fallback bug is fixed here by requiring a work_dir);
+- TensorBoard scalars with the reference's exact tag names/cadence
+  (``info/*`` per-iter, ``loss``/``learning_rate`` per-epoch,
+  ``classifier/*`` for the probe) via torch's SummaryWriter;
+- stdout progress lines from the epoch drivers.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+_FMT = "%(asctime)s %(filename)s [line:%(lineno)d] %(levelname)s %(message)s"
+
+
+def setup_logging(
+    work_dir: Optional[str] = None,
+    is_main: bool = True,
+    level: int = logging.INFO,
+) -> None:
+    """Stream logger everywhere; file logger ``log-ing`` on the main process."""
+    root = logging.getLogger()
+    root.setLevel(level)
+    if not any(isinstance(h, logging.StreamHandler) and not isinstance(h, logging.FileHandler)
+               for h in root.handlers):
+        sh = logging.StreamHandler()
+        sh.setFormatter(logging.Formatter(_FMT))
+        root.addHandler(sh)
+    if work_dir and is_main:
+        os.makedirs(work_dir, exist_ok=True)
+        fh = logging.FileHandler(os.path.join(work_dir, "log-ing"))
+        fh.setFormatter(logging.Formatter(_FMT))
+        root.addHandler(fh)
+
+
+class TBLogger:
+    """tb_logger.Logger-compatible facade over SummaryWriter; no-op off-main."""
+
+    def __init__(self, logdir: str, enabled: bool = True):
+        self._writer = None
+        if enabled:
+            os.makedirs(logdir, exist_ok=True)
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._writer = SummaryWriter(log_dir=logdir, flush_secs=2)
+            except Exception as e:  # pragma: no cover - environment-dependent
+                logging.warning("TensorBoard writer unavailable (%s); disabled", e)
+
+    def log_value(self, tag: str, value, step: int) -> None:
+        if self._writer is not None:
+            self._writer.add_scalar(tag, float(value), int(step))
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()

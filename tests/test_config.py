@@ -1,0 +1,62 @@
+"""Config parity tests: flag names/defaults and the derivations that matter
+(model_name encoding, auto-warm, closed-form warmup_to)."""
+
+import math
+
+from simclr_pytorch_distributed_tpu.config import (
+    config_dict,
+    parse_linear,
+    parse_supcon,
+)
+
+
+def test_supcon_defaults_match_reference(tmp_path):
+    cfg = parse_supcon(["--workdir", str(tmp_path)])
+    assert cfg.print_freq == 10 and cfg.save_freq == 20
+    assert cfg.batch_size == 256 and cfg.epochs == 1000
+    assert cfg.learning_rate == 0.5 and cfg.lr_decay_epochs == (700, 800, 900)
+    assert cfg.lr_decay_rate == 0.1 and cfg.weight_decay == 1e-4
+    assert cfg.model == "resnet50" and cfg.dataset == "cifar10"
+    assert cfg.method == "SimCLR" and cfg.temp == 0.5
+    assert cfg.norm_momentum == 1.0 and cfg.ngpu == 2
+    assert cfg.data_folder == "./datasets/"
+
+
+def test_model_name_encoding(tmp_path):
+    cfg = parse_supcon(
+        ["--cosine", "--method", "SimCLR", "--trial", "3", "--workdir", str(tmp_path)]
+    )
+    assert cfg.model_name == (
+        "SimCLR_cifar10_resnet50_lr_0.5_decay_0.0001_bsz_256_temp_0.5_trial_3_cosine"
+    )
+    assert "cifar10_models" in cfg.save_folder
+    assert cfg.model_name in cfg.save_folder
+
+
+def test_auto_warm_large_batch(tmp_path):
+    cfg = parse_supcon(
+        ["--batch_size", "512", "--cosine", "--epochs", "200", "--workdir", str(tmp_path)]
+    )
+    assert cfg.warm  # bs > 256 forces warmup (main_supcon.py:120-121)
+    assert cfg.warm_epochs == 10 and cfg.warmup_from == 0.01
+    eta_min = 0.5 * 0.1**3
+    want = eta_min + (0.5 - eta_min) * (1 + math.cos(math.pi * 10 / 200)) / 2
+    assert abs(cfg.warmup_to - want) < 1e-9
+    assert cfg.model_name.endswith("_warm")
+
+
+def test_linear_defaults(tmp_path):
+    cfg = parse_linear(["--workdir", str(tmp_path)])
+    assert cfg.batch_size == 512 and cfg.epochs == 100
+    assert cfg.learning_rate == 0.1 and cfg.lr_decay_epochs == (60, 75, 90)
+    assert cfg.lr_decay_rate == 0.2 and cfg.weight_decay == 0.0
+    assert cfg.n_cls == 10
+    cfg100 = parse_linear(["--dataset", "cifar100", "--workdir", str(tmp_path)])
+    assert cfg100.n_cls == 100
+
+
+def test_config_dict_json_safe(tmp_path):
+    import json
+
+    cfg = parse_supcon(["--workdir", str(tmp_path)])
+    json.dumps(config_dict(cfg))  # must not raise
