@@ -99,12 +99,16 @@ def ring_supcon_loss(
         block = jax.lax.ppermute(block, axis_name, perm)
         return (block, new_max, run_sum, pos_acc, pos_cnt), None
 
+    def dev_varying(x):
+        # mark fresh accumulators as device-varying for shard_map's vma typing
+        return jax.lax.pvary(x, (axis_name,))
+
     init = (
         feats_local,
-        jnp.full((m,), _NEG_INF, feats_local.dtype),
-        jnp.zeros((m,), feats_local.dtype),
-        jnp.zeros((m,), feats_local.dtype),
-        jnp.zeros((m,), feats_local.dtype),
+        dev_varying(jnp.full((m,), _NEG_INF, feats_local.dtype)),
+        dev_varying(jnp.zeros((m,), feats_local.dtype)),
+        dev_varying(jnp.zeros((m,), feats_local.dtype)),
+        dev_varying(jnp.zeros((m,), feats_local.dtype)),
     )
     (_, run_max, run_sum, pos_acc, pos_cnt), _ = jax.lax.scan(
         ring_step, init, jnp.arange(p)
