@@ -47,9 +47,12 @@ def main():
     state = create_train_state(
         model, tx, jax.random.key(0), jnp.zeros((2, size, size, 3))
     )
+    from simclr_pytorch_distributed_tpu.train.supcon import resolve_loss_impl
+
+    loss_impl = resolve_loss_impl("auto", batch, n_chips)
     step_cfg = SupConStepConfig(
         method="SimCLR", temperature=0.5, epochs=100,
-        steps_per_epoch=steps_per_epoch, grad_div=2.0,
+        steps_per_epoch=steps_per_epoch, grad_div=2.0, loss_impl=loss_impl,
     )
     update = make_fused_update(
         model, tx, schedule, step_cfg, AugmentConfig(size=size), mesh, state
@@ -84,7 +87,7 @@ def main():
             "chips": n_chips,
             "total_imgs_per_sec": round(imgs_per_sec, 1),
             "step_ms": round(1000 * dt / n_steps, 2),
-            "config": "SimCLR rn50 cifar-recipe bf16 fused-aug",
+            "config": f"SimCLR rn50 cifar-recipe bf16 fused-aug loss={loss_impl}",
         },
     }))
 

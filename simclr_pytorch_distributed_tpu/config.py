@@ -76,6 +76,16 @@ class SupConConfig:
     seed: int = 0
     workdir: str = "./work_space"
     tb_every: int = 10  # per-iter TB cadence (reference logs every iter)
+    # contrastive-loss implementation: 'auto' picks the fused Pallas kernel on
+    # a single TPU chip, the dense XLA path otherwise (ops/pallas_loss.py)
+    loss_impl: str = "auto"
+    # jax.profiler trace capture (SURVEY.md §5 tracing row; reference has none)
+    trace_dir: str = ""
+    trace_start_step: int = 10
+    trace_steps: int = 10
+    # persistent XLA compile cache ('auto' = <workdir>/.jax_cache, '' = off);
+    # cuts the ~40-80s first-step compile on restarts/resumes
+    compile_cache: str = "auto"
     # derived (finalize_supcon)
     warm_epochs: int = 10
     warmup_from: float = 0.01
@@ -131,6 +141,13 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--workdir", type=str, default=d.workdir)
     p.add_argument("--tb_every", type=int, default=d.tb_every)
+    p.add_argument("--loss_impl", type=str, default=d.loss_impl,
+                   choices=["auto", "dense", "fused"])
+    p.add_argument("--trace_dir", type=str, default=d.trace_dir,
+                   help="capture a jax.profiler trace into this dir")
+    p.add_argument("--trace_start_step", type=int, default=d.trace_start_step)
+    p.add_argument("--trace_steps", type=int, default=d.trace_steps)
+    p.add_argument("--compile_cache", type=str, default=d.compile_cache)
     return p
 
 

@@ -17,6 +17,7 @@ Perf notes vs the reference hot loop:
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 import jax
@@ -31,6 +32,7 @@ from simclr_pytorch_distributed_tpu.ops.augment import (
     AugmentConfig,
     two_crop_batch,
 )
+from simclr_pytorch_distributed_tpu.ops import pallas_loss
 from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
@@ -56,6 +58,7 @@ from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     save_checkpoint,
 )
 from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
+from simclr_pytorch_distributed_tpu.utils.profiling import StepTracer
 
 
 def make_augment_config(cfg: config_lib.SupConConfig, color_ops: bool = True) -> AugmentConfig:
@@ -70,7 +73,25 @@ def make_augment_config(cfg: config_lib.SupConConfig, color_ops: bool = True) ->
     return AugmentConfig(size=cfg.size, mean=mean, std=std, color_ops=color_ops)
 
 
-def build(cfg: config_lib.SupConConfig, steps_per_epoch: int):
+def resolve_loss_impl(loss_impl: str, batch_size: int, n_devices: int) -> str:
+    """'auto' -> the fused Pallas kernel on a single TPU chip, dense otherwise.
+
+    The dense path stays the default under a multi-device mesh: GSPMD partitions
+    its plain matmul/softmax HLO across the ``data`` axis, whereas a pallas_call
+    would need explicit shard_map plumbing to avoid full replication.
+    """
+    if loss_impl != "auto":
+        return loss_impl
+    if (
+        jax.default_backend() == "tpu"
+        and n_devices == 1
+        and pallas_loss.supports(batch_size, 2)
+    ):
+        return "fused"
+    return "dense"
+
+
+def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1):
     """Model, schedule, optimizer, initial state, and the fused jitted update."""
     dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
     model = SupConResNet(
@@ -93,6 +114,7 @@ def build(cfg: config_lib.SupConConfig, steps_per_epoch: int):
         sec=cfg.sec, sec_wei=cfg.sec_wei, l2reg=cfg.l2reg, l2reg_wei=cfg.l2reg_wei,
         norm_momentum=cfg.norm_momentum, epochs=cfg.epochs,
         steps_per_epoch=steps_per_epoch, grad_div=float(cfg.ngpu),
+        loss_impl=resolve_loss_impl(cfg.loss_impl, cfg.batch_size, n_devices),
     )
     return model, schedule, tx, state, step_cfg
 
@@ -116,7 +138,8 @@ def make_fused_update(model, tx, schedule, step_cfg, aug_cfg, mesh, state_exampl
 
 
 def train_one_epoch(
-    epoch, loader, update_fn, state, mesh, base_key, cfg, tb, steps_per_epoch
+    epoch, loader, update_fn, state, mesh, base_key, cfg, tb, steps_per_epoch,
+    tracer=None,
 ):
     """One epoch (reference train(), main_supcon.py:242-351)."""
     batch_time, data_time, losses = AverageMeter(), AverageMeter(), AverageMeter()
@@ -131,6 +154,8 @@ def train_one_epoch(
         batch = shard_host_batch((images_u8, labels), mesh)
         state, metrics = update_fn(state, batch[0], batch[1], key)
         pending = (idx, global_step, metrics)
+        if tracer is not None:
+            tracer.step(global_step)
 
         if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
             idx_f, gstep_f, m = pending
@@ -160,8 +185,21 @@ def train_one_epoch(
     return state, losses.avg if losses.count else last_metrics.get("loss", 0.0), last_metrics
 
 
+def enable_compile_cache(compile_cache: str, workdir: str) -> None:
+    """Persistent XLA compile cache: restarts/resumes skip the cold compile."""
+    if not compile_cache:
+        return
+    path = (
+        os.path.join(workdir, ".jax_cache") if compile_cache == "auto"
+        else compile_cache
+    )
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(path))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 def run(cfg: config_lib.SupConConfig) -> TrainState:
     setup_distributed()
+    enable_compile_cache(cfg.compile_cache, cfg.workdir)
     setup_logging(cfg.save_folder, is_main_process())
     mesh = create_mesh(model_parallel=cfg.model_parallel)
     logging.info("mesh: %s over %d devices", dict(mesh.shape), mesh.size)
@@ -176,7 +214,8 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         process_count=jax.process_count(),
     )
     steps_per_epoch = len(loader)
-    model, schedule, tx, state, step_cfg = build(cfg, steps_per_epoch)
+    model, schedule, tx, state, step_cfg = build(cfg, steps_per_epoch, mesh.size)
+    logging.info("contrastive loss impl: %s", step_cfg.loss_impl)
 
     start_epoch = 1
     if cfg.ckpt:
@@ -197,11 +236,16 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
     update_fn = make_fused_update(model, tx, schedule, step_cfg, aug_cfg, mesh, state)
     tb = TBLogger(cfg.tb_folder, enabled=is_main_process())
     base_key = jax.random.key(cfg.seed + 1)
+    tracer = StepTracer(
+        cfg.trace_dir, cfg.trace_start_step, cfg.trace_steps,
+        enabled=is_main_process(),
+    )
 
     for epoch in range(start_epoch, cfg.epochs + 1):
         t1 = time.time()
         state, loss_avg, metrics = train_one_epoch(
-            epoch, loader, update_fn, state, mesh, base_key, cfg, tb, steps_per_epoch
+            epoch, loader, update_fn, state, mesh, base_key, cfg, tb,
+            steps_per_epoch, tracer=tracer,
         )
         t2 = time.time()
         logging.info("epoch %d, total time %.2f", epoch, t2 - t1)
@@ -218,6 +262,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
             cfg.save_folder, "last", state,
             config=config_lib.config_dict(cfg), epoch=cfg.epochs,
         )
+    tracer.close()
     tb.close()
     return state
 

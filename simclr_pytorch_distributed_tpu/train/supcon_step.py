@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import optax
 
 from simclr_pytorch_distributed_tpu.ops.losses import supcon_loss
+from simclr_pytorch_distributed_tpu.ops.pallas_loss import fused_supcon_loss
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
     batch_sharding,
     replicated_sharding,
@@ -62,6 +63,9 @@ class SupConStepConfig:
     steps_per_epoch: int = 1
     # DDP gradient-mean fidelity (see module docstring); the recipe's --ngpu.
     grad_div: float = 2.0
+    # 'dense' = XLA O(N^2)-materializing path; 'fused' = flash-style Pallas
+    # kernel (ops/pallas_loss.py). Resolved from the config's 'auto' upstream.
+    loss_impl: str = "dense"
 
 
 def two_view_forward(model, params, batch_stats, images: jax.Array, *, train: bool = True):
@@ -124,20 +128,28 @@ def make_train_step(
         n_fea = feats / jnp.linalg.norm(feats, axis=1, keepdims=True)
         n_features = jnp.stack([n_fea[:B], n_fea[B:]], axis=1)
 
-        if cfg.method == "SupCon":
-            contrastive = supcon_loss(
-                n_features, labels=labels,
-                temperature=cfg.temperature, base_temperature=cfg.base_temperature,
-                contrast_mode=cfg.contrast_mode,
+        if cfg.method not in ("SupCon", "SimCLR"):
+            raise ValueError(f"contrastive method not supported: {cfg.method}")
+        loss_labels = labels if cfg.method == "SupCon" else None
+        if cfg.loss_impl == "fused" and cfg.contrast_mode != "all":
+            raise ValueError(
+                "the fused Pallas loss implements contrast_mode='all' only; "
+                f"got {cfg.contrast_mode!r} — use loss_impl='dense'"
             )
-        elif cfg.method == "SimCLR":
-            contrastive = supcon_loss(
-                n_features,
+        if cfg.loss_impl == "fused":
+            contrastive = fused_supcon_loss(
+                n_features, labels=loss_labels,
                 temperature=cfg.temperature, base_temperature=cfg.base_temperature,
-                contrast_mode=cfg.contrast_mode,
+                # Mosaic compiles only on TPU; anywhere else (CPU tests) the
+                # kernel runs under the Pallas interpreter.
+                interpret=jax.default_backend() != "tpu",
             )
         else:
-            raise ValueError(f"contrastive method not supported: {cfg.method}")
+            contrastive = supcon_loss(
+                n_features, labels=loss_labels,
+                temperature=cfg.temperature, base_temperature=cfg.base_temperature,
+                contrast_mode=cfg.contrast_mode,
+            )
 
         # linear-ramped aux terms (main_supcon.py:311-317)
         ramp = state.step / (cfg.epochs * cfg.steps_per_epoch)

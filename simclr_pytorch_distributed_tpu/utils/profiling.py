@@ -1,0 +1,49 @@
+"""Windowed jax.profiler trace capture (SURVEY.md §5: the reference has only
+wall-clock AverageMeters, no profiler at all — this is the TPU-native upgrade).
+
+A ``StepTracer`` starts a TensorBoard-loadable trace at ``start_step`` and
+stops it ``num_steps`` later, skipping the compile-dominated first iterations.
+View with ``tensorboard --logdir <trace_dir>`` (Profile tab) or xprof.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+
+class StepTracer:
+    def __init__(
+        self,
+        trace_dir: str,
+        start_step: int = 10,
+        num_steps: int = 10,
+        enabled: bool = True,
+    ):
+        self.trace_dir = trace_dir
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self.enabled = bool(trace_dir) and enabled
+        self._active = False
+
+    def step(self, global_step: int) -> None:
+        """Call once per training step with the global step index."""
+        if not self.enabled:
+            return
+        # >= not ==: after a checkpoint resume the first observed step may
+        # already be past start_step; still capture a window.
+        if not self._active and global_step >= self.start_step:
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+            logging.info("profiler: tracing steps [%d, %d) -> %s",
+                         self.start_step, self.stop_step, self.trace_dir)
+            self.stop_step = global_step + self.stop_step - self.start_step
+        elif self._active and global_step >= self.stop_step:
+            self.close()
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self.enabled = False  # one window per run
