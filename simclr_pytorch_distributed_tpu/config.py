@@ -77,7 +77,9 @@ class SupConConfig:
     workdir: str = "./work_space"
     tb_every: int = 10  # per-iter TB cadence (reference logs every iter)
     # contrastive-loss implementation: 'auto' picks the fused Pallas kernel on
-    # a single TPU chip, the dense XLA path otherwise (ops/pallas_loss.py)
+    # a single TPU chip, the dense XLA path otherwise (ops/pallas_loss.py);
+    # 'ring' streams contrast blocks around the data axis with ppermute
+    # (parallel/collectives.py) for large-global-batch memory scaling
     loss_impl: str = "auto"
     # jax.profiler trace capture (SURVEY.md §5 tracing row; reference has none)
     trace_dir: str = ""
@@ -142,7 +144,7 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--workdir", type=str, default=d.workdir)
     p.add_argument("--tb_every", type=int, default=d.tb_every)
     p.add_argument("--loss_impl", type=str, default=d.loss_impl,
-                   choices=["auto", "dense", "fused"])
+                   choices=["auto", "dense", "fused", "ring"])
     p.add_argument("--trace_dir", type=str, default=d.trace_dir,
                    help="capture a jax.profiler trace into this dir")
     p.add_argument("--trace_start_step", type=int, default=d.trace_start_step)

@@ -121,7 +121,7 @@ def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1
 
 def make_fused_update(model, tx, schedule, step_cfg, aug_cfg, mesh, state_example):
     """augment(two crops) + train step as one GSPMD program."""
-    train_step = make_train_step(model, tx, schedule, step_cfg)
+    train_step = make_train_step(model, tx, schedule, step_cfg, mesh=mesh)
 
     def update(state: TrainState, images_u8, labels, key):
         views = two_crop_batch(key, images_u8, aug_cfg)

@@ -35,9 +35,14 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
 from simclr_pytorch_distributed_tpu.ops.losses import supcon_loss
 from simclr_pytorch_distributed_tpu.ops.pallas_loss import fused_supcon_loss
+from simclr_pytorch_distributed_tpu.parallel.collectives import ring_supcon_loss
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
+    DATA_AXIS,
     batch_sharding,
     replicated_sharding,
 )
@@ -64,7 +69,10 @@ class SupConStepConfig:
     # DDP gradient-mean fidelity (see module docstring); the recipe's --ngpu.
     grad_div: float = 2.0
     # 'dense' = XLA O(N^2)-materializing path; 'fused' = flash-style Pallas
-    # kernel (ops/pallas_loss.py). Resolved from the config's 'auto' upstream.
+    # kernel (ops/pallas_loss.py); 'ring' = ppermute-sharded streaming loss
+    # (parallel/collectives.py) that keeps anchors sharded over the 'data'
+    # axis — O((2B/P)^2) per-device memory for large global batches.
+    # Resolved from the config's 'auto' upstream.
     loss_impl: str = "dense"
 
 
@@ -96,8 +104,15 @@ def make_train_step(
     tx: optax.GradientTransformation,
     schedule: Callable,
     cfg: SupConStepConfig,
+    mesh=None,
 ) -> Callable:
-    """Build the pure train step: (state, images[B,2,H,W,C], labels[B]) -> (state, metrics)."""
+    """Build the pure train step: (state, images[B,2,H,W,C], labels[B]) -> (state, metrics).
+
+    ``mesh`` is required only for ``loss_impl='ring'`` (the shard_map needs an
+    explicit mesh; dense/fused run as plain HLO that GSPMD partitions).
+    """
+    if cfg.loss_impl == "ring" and mesh is None:
+        raise ValueError("loss_impl='ring' needs the mesh passed to make_train_step")
 
     def loss_fn(params, state: TrainState, images, labels):
         feats, new_batch_stats = two_view_forward(
@@ -131,12 +146,32 @@ def make_train_step(
         if cfg.method not in ("SupCon", "SimCLR"):
             raise ValueError(f"contrastive method not supported: {cfg.method}")
         loss_labels = labels if cfg.method == "SupCon" else None
-        if cfg.loss_impl == "fused" and cfg.contrast_mode != "all":
+        if cfg.loss_impl in ("fused", "ring") and cfg.contrast_mode != "all":
             raise ValueError(
-                "the fused Pallas loss implements contrast_mode='all' only; "
-                f"got {cfg.contrast_mode!r} — use loss_impl='dense'"
+                f"loss_impl={cfg.loss_impl!r} implements contrast_mode='all' "
+                f"only; got {cfg.contrast_mode!r} — use loss_impl='dense'"
             )
-        if cfg.loss_impl == "fused":
+        if cfg.loss_impl == "ring":
+            # anchors stay sharded over 'data'; n_fea is already the view-major
+            # global row layout the ring expects ([v1 rows; v2 rows]).
+            def _ring(rows, lab):
+                return ring_supcon_loss(
+                    rows, lab, axis_name=DATA_AXIS,
+                    temperature=cfg.temperature,
+                    base_temperature=cfg.base_temperature, n_views=2,
+                )
+
+            if loss_labels is None:
+                contrastive = shard_map(
+                    lambda r: _ring(r, None),
+                    mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(),
+                )(n_fea)
+            else:
+                contrastive = shard_map(
+                    _ring, mesh=mesh,
+                    in_specs=(P(DATA_AXIS), P()), out_specs=P(),
+                )(n_fea, loss_labels)
+        elif cfg.loss_impl == "fused":
             contrastive = fused_supcon_loss(
                 n_features, labels=loss_labels,
                 temperature=cfg.temperature, base_temperature=cfg.base_temperature,
@@ -206,7 +241,7 @@ def make_sharded_train_step(
     feature all-gather for the loss matmul and a gradient reduce over ICI —
     the TPU-native replacement for NCCL all_gather + DDP bucketed all-reduce.
     """
-    step = make_train_step(model, tx, schedule, cfg)
+    step = make_train_step(model, tx, schedule, cfg, mesh=mesh)
     repl = replicated_sharding(mesh)
 
     def state_sharding(s):
