@@ -84,13 +84,29 @@ def synthetic_dataset(
 
 
 def load_dataset(
-    dataset: str, data_folder: str, allow_synthetic_fallback: bool = False
+    dataset: str,
+    data_folder: str,
+    allow_synthetic_fallback: bool = False,
+    size: int = 32,
 ) -> Tuple[NumpyDataset, NumpyDataset, int]:
     """Returns (train, test, num_classes). ``dataset`` in {cifar10, cifar100,
-    synthetic}; with ``allow_synthetic_fallback`` a missing on-disk dataset
-    degrades to synthetic data with a warning (benchmark environments)."""
+    path, synthetic}; with ``allow_synthetic_fallback`` a missing on-disk
+    dataset degrades to synthetic data with a warning (benchmark environments).
+    ``path`` reads an ImageFolder-style class-per-subdir tree (train split
+    only, like the reference main_supcon.py:189-191); ``size`` sets its
+    device-crop target."""
     import logging
 
+    if dataset == "path":
+        from simclr_pytorch_distributed_tpu.data.folder import load_image_folder
+
+        train, classes = load_image_folder(data_folder, size=size)
+        # no val split in the reference's path mode; empty test set
+        empty = {
+            "images": train["images"][:0],
+            "labels": train["labels"][:0],
+        }
+        return train, empty, len(classes)
     if dataset == "cifar10":
         n_cls, loader, marker = 10, load_cifar10, "cifar-10-batches-py"
     elif dataset == "cifar100":

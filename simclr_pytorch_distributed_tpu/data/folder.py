@@ -1,0 +1,73 @@
+"""ImageFolder-equivalent reader for ``--dataset path`` (class-per-subdirectory).
+
+The reference feeds custom datasets through ``torchvision.datasets.ImageFolder``
+(``main_supcon.py:189-191``): every immediate subdirectory of the root is a
+class, sorted by name. Here images are decoded once with PIL on the host into a
+uint8 array at a fixed ``store_size`` resolution; the SimCLR RandomResizedCrop
+then runs on DEVICE from that stored resolution (ops/augment.py), replacing the
+reference's per-epoch PIL re-decode in 8 DataLoader workers.
+
+``store_size`` defaults to 2x the crop size so the device-side crop keeps the
+scale diversity of cropping near-original resolution, while the host array
+stays bounded (N * store_size^2 * 3 bytes).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from simclr_pytorch_distributed_tpu.data.cifar import NumpyDataset
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp", ".ppm")
+
+
+def find_classes(root: str) -> List[str]:
+    """Sorted immediate subdirectories = classes (ImageFolder semantics)."""
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+    )
+    if not classes:
+        raise FileNotFoundError(f"no class subdirectories under {root}")
+    return classes
+
+
+def load_image_folder(
+    root: str,
+    size: int = 32,
+    store_size: Optional[int] = None,
+) -> Tuple[NumpyDataset, List[str]]:
+    """Decode a class-per-subdir image tree into uint8 [N, S, S, 3] + labels.
+
+    Args:
+      root: dataset root (each subdir is one class).
+      size: the training crop size (``--size``).
+      store_size: host-side storage resolution; default ``2 * size``.
+
+    Returns:
+      ({'images': u8 [N,S,S,3], 'labels': i32 [N]}, class_names)
+    """
+    from PIL import Image
+
+    s = store_size or 2 * size
+    classes = find_classes(root)
+    images, labels = [], []
+    for cls_idx, cls in enumerate(classes):
+        cls_dir = os.path.join(root, cls)
+        for dirpath, _, filenames in sorted(os.walk(cls_dir)):
+            for fname in sorted(filenames):
+                if not fname.lower().endswith(IMG_EXTENSIONS):
+                    continue
+                with Image.open(os.path.join(dirpath, fname)) as im:
+                    im = im.convert("RGB").resize((s, s), Image.BILINEAR)
+                    images.append(np.asarray(im, dtype=np.uint8))
+                labels.append(cls_idx)
+    if not images:
+        raise FileNotFoundError(f"no images with {IMG_EXTENSIONS} under {root}")
+    data = {
+        "images": np.stack(images),
+        "labels": np.asarray(labels, np.int32),
+    }
+    return data, classes

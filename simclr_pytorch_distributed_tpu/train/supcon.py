@@ -205,8 +205,8 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
     logging.info("mesh: %s over %d devices", dict(mesh.shape), mesh.size)
 
     train_data, _, _ = load_dataset(
-        cfg.dataset if cfg.dataset != "path" else "synthetic",
-        cfg.data_folder, allow_synthetic_fallback=(cfg.dataset == "synthetic"),
+        cfg.dataset, cfg.data_folder,
+        allow_synthetic_fallback=(cfg.dataset == "synthetic"), size=cfg.size,
     )
     loader = EpochLoader(
         train_data["images"], train_data["labels"], cfg.batch_size,

@@ -1,0 +1,57 @@
+"""ImageFolder-equivalent reader: class-per-subdir tree -> uint8 arrays."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from simclr_pytorch_distributed_tpu.data.cifar import load_dataset
+from simclr_pytorch_distributed_tpu.data.folder import (
+    find_classes,
+    load_image_folder,
+)
+
+
+@pytest.fixture
+def image_tree(tmp_path):
+    rng = np.random.default_rng(0)
+    counts = {"cats": 3, "dogs": 2}
+    for cls, n in counts.items():
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(n):
+            arr = rng.integers(0, 256, size=(48, 64, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"img_{i}.png")
+    (tmp_path / "notes.txt").write_text("not an image")
+    return tmp_path, counts
+
+
+def test_classes_sorted_and_labeled(image_tree):
+    root, counts = image_tree
+    assert find_classes(str(root)) == ["cats", "dogs"]
+    data, classes = load_image_folder(str(root), size=16)
+    assert classes == ["cats", "dogs"]
+    assert data["images"].shape == (5, 32, 32, 3)  # store_size = 2*size
+    assert data["images"].dtype == np.uint8
+    np.testing.assert_array_equal(np.bincount(data["labels"]), [3, 2])
+
+
+def test_store_size_override(image_tree):
+    root, _ = image_tree
+    data, _ = load_image_folder(str(root), size=16, store_size=24)
+    assert data["images"].shape[1:] == (24, 24, 3)
+
+
+def test_load_dataset_path_mode(image_tree):
+    root, _ = image_tree
+    train, test, n_cls = load_dataset("path", str(root), size=16)
+    assert n_cls == 2
+    assert train["images"].shape[0] == 5
+    assert test["images"].shape[0] == 0  # no val split in path mode
+
+
+def test_empty_root_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_image_folder(str(tmp_path))
+    (tmp_path / "cls_a").mkdir()
+    with pytest.raises(FileNotFoundError):
+        load_image_folder(str(tmp_path))
