@@ -39,27 +39,23 @@ DATASET_STATS = {
 }
 
 
-def _bilinear_sample(img: jax.Array, ys: jax.Array, xs: jax.Array) -> jax.Array:
-    """Sample img[H,W,C] at float coords (ys, xs) grids with edge clamping."""
-    H, W = img.shape[0], img.shape[1]
-    # clamp-to-edge BEFORE flooring so out-of-range coords replicate the border
-    ys = jnp.clip(ys, 0.0, H - 1.0)
-    xs = jnp.clip(xs, 0.0, W - 1.0)
-    y0 = jnp.floor(ys)
-    x0 = jnp.floor(xs)
-    wy = ys - y0
-    wx = xs - x0
-    y0i = jnp.clip(y0.astype(jnp.int32), 0, H - 1)
-    y1i = jnp.clip(y0i + 1, 0, H - 1)
-    x0i = jnp.clip(x0.astype(jnp.int32), 0, W - 1)
-    x1i = jnp.clip(x0i + 1, 0, W - 1)
+def _interp_matrix(coords: jax.Array, n: int) -> jax.Array:
+    """Dense bilinear interpolation matrix M[out, n]: out = M @ src.
 
-    def g(yi, xi):
-        return img[yi[:, None], xi[None, :], :]
-
-    top = g(y0i, x0i) * (1 - wx)[None, :, None] + g(y0i, x1i) * wx[None, :, None]
-    bot = g(y1i, x0i) * (1 - wx)[None, :, None] + g(y1i, x1i) * wx[None, :, None]
-    return top * (1 - wy)[:, None, None] + bot * wy[:, None, None]
+    Row i holds the two hat-function weights for sampling at ``coords[i]`` with
+    edge clamping (out-of-range coords replicate the border; at the border the
+    two taps coincide and their weights sum to 1).
+    """
+    c = jnp.clip(coords, 0.0, n - 1.0)
+    c0 = jnp.floor(c)
+    frac = c - c0
+    c0i = jnp.clip(c0.astype(jnp.int32), 0, n - 1)
+    c1i = jnp.clip(c0i + 1, 0, n - 1)
+    grid = jnp.arange(n)[None, :]
+    return (
+        (grid == c0i[:, None]) * (1.0 - frac)[:, None]
+        + (grid == c1i[:, None]) * frac[:, None]
+    )
 
 
 def crop_and_resize(
@@ -68,14 +64,19 @@ def crop_and_resize(
 ) -> jax.Array:
     """Bilinear-resize the (top, left, h, w) crop to (out_size, out_size).
 
-    h/w/top/left are traced scalars — the crop+resize is expressed as one gather
-    (dynamic_slice can't take traced sizes), which XLA lowers well on TPU.
-    Half-pixel-center convention matches PIL/torchvision bilinear resize.
+    h/w/top/left are traced scalars (dynamic_slice can't take traced sizes), so
+    the crop+resize is expressed as two small dense interpolation matmuls —
+    under vmap these batch onto the MXU, unlike a per-pixel gather, which TPUs
+    lower poorly. Half-pixel-center convention matches PIL/torchvision bilinear.
     """
+    H, W = img.shape[0], img.shape[1]
     d = jnp.arange(out_size, dtype=jnp.float32)
     ys = top + (d + 0.5) * (h / out_size) - 0.5
     xs = left + (d + 0.5) * (w / out_size) - 0.5
-    return _bilinear_sample(img, ys, xs)
+    wy = _interp_matrix(ys, H)  # [out, H]
+    wx = _interp_matrix(xs, W)  # [out, W]
+    rows = jnp.einsum("sh,hwc->swc", wy, img)
+    return jnp.einsum("xw,swc->sxc", wx, rows)
 
 
 def random_resized_crop(
