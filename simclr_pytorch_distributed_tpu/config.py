@@ -88,6 +88,8 @@ class SupConConfig:
     # persistent XLA compile cache ('auto' = <workdir>/.jax_cache, '' = off);
     # cuts the ~40-80s first-step compile on restarts/resumes
     compile_cache: str = "auto"
+    # abort + emergency-checkpoint on NaN/Inf loss (utils/guard.py)
+    nan_guard: bool = True
     # derived (finalize_supcon)
     warm_epochs: int = 10
     warmup_from: float = 0.01
@@ -150,6 +152,8 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace_start_step", type=int, default=d.trace_start_step)
     p.add_argument("--trace_steps", type=int, default=d.trace_steps)
     p.add_argument("--compile_cache", type=str, default=d.compile_cache)
+    p.add_argument("--nan_guard", type=lambda s: s.lower() not in ("0", "false"),
+                   default=d.nan_guard, help="abort + checkpoint on NaN loss")
     return p
 
 

@@ -57,6 +57,10 @@ from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
 )
+from simclr_pytorch_distributed_tpu.utils.guard import (
+    NonFiniteLossError,
+    check_finite_loss,
+)
 from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
 from simclr_pytorch_distributed_tpu.utils.profiling import StepTracer
 
@@ -160,6 +164,7 @@ def train_one_epoch(
         if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
             idx_f, gstep_f, m = pending
             m = {k: float(v) for k, v in m.items()}  # device sync point
+            check_finite_loss(m["loss"], gstep_f, cfg.nan_guard)
             losses.update(m["loss"], bsz)
             if is_main_process() and tb is not None:
                 # per-iter scalars (reference main_supcon.py:327-333)
@@ -243,10 +248,22 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
 
     for epoch in range(start_epoch, cfg.epochs + 1):
         t1 = time.time()
-        state, loss_avg, metrics = train_one_epoch(
-            epoch, loader, update_fn, state, mesh, base_key, cfg, tb,
-            steps_per_epoch, tracer=tracer,
-        )
+        try:
+            state, loss_avg, metrics = train_one_epoch(
+                epoch, loader, update_fn, state, mesh, base_key, cfg, tb,
+                steps_per_epoch, tracer=tracer,
+            )
+        except NonFiniteLossError:
+            # emergency save of the last epoch-boundary state so --resume can
+            # restart after the root cause is addressed (failure detection,
+            # SURVEY.md §5 — absent upstream)
+            if is_main_process():
+                save_checkpoint(
+                    cfg.save_folder, f"crash_epoch_{epoch}", state,
+                    config=config_lib.config_dict(cfg), epoch=epoch - 1,
+                )
+                logging.error("non-finite loss: saved crash_epoch_%d", epoch)
+            raise
         t2 = time.time()
         logging.info("epoch %d, total time %.2f", epoch, t2 - t1)
         if is_main_process():

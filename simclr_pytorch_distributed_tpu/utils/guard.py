@@ -1,0 +1,33 @@
+"""Failure detection (SURVEY.md §5: absent upstream — a rank crash just hangs
+NCCL until timeout and all progress is lost since there is no resume).
+
+Here the cheap, high-value guard is numeric: a non-finite loss observed at the
+metrics fetch aborts the run with an emergency checkpoint of the last known-good
+state instead of silently training on NaNs for hours. Combined with
+``--resume``, the run restarts from the crash checkpoint after the root cause
+(LR spike, bad batch) is addressed.
+
+The check piggybacks on the every-``print_freq`` device sync the meters already
+do, so it adds zero extra host<->device round-trips to the hot loop.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+class NonFiniteLossError(RuntimeError):
+    """Raised when the training loss goes NaN/Inf."""
+
+    def __init__(self, loss: float, step: int):
+        super().__init__(
+            f"non-finite loss {loss!r} at global step {step}; aborting "
+            "(an emergency checkpoint of the last epoch boundary is saved)"
+        )
+        self.loss = loss
+        self.step = step
+
+
+def check_finite_loss(loss: float, step: int, enabled: bool = True) -> None:
+    if enabled and not math.isfinite(loss):
+        raise NonFiniteLossError(loss, step)

@@ -1,0 +1,62 @@
+"""NaN-loss failure detection: abort + emergency checkpoint via the driver."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu import config as config_lib
+from simclr_pytorch_distributed_tpu.parallel.mesh import create_mesh
+from simclr_pytorch_distributed_tpu.train.supcon import train_one_epoch
+from simclr_pytorch_distributed_tpu.utils.guard import (
+    NonFiniteLossError,
+    check_finite_loss,
+)
+
+
+def test_check_finite_loss():
+    check_finite_loss(1.0, 0)
+    check_finite_loss(float("nan"), 0, enabled=False)  # disabled: no raise
+    for bad in (float("nan"), float("inf"), -float("inf")):
+        with pytest.raises(NonFiniteLossError, match="non-finite loss"):
+            check_finite_loss(bad, 7)
+
+
+class _FakeLoader:
+    def __init__(self, n_steps, batch):
+        self.n_steps, self.batch = n_steps, batch
+
+    def epoch(self, _):
+        images = np.zeros((self.batch, 4, 4, 3), np.uint8)
+        labels = np.zeros((self.batch,), np.int32)
+        for _ in range(self.n_steps):
+            yield images, labels
+
+
+def test_epoch_loop_raises_on_nan(monkeypatch):
+    cfg = config_lib.SupConConfig(print_freq=1, batch_size=8, nan_guard=True)
+    mesh = create_mesh(devices=jax.devices()[:1])
+    metrics = {
+        "loss": jnp.float32(float("nan")), "norm_mean": jnp.float32(0),
+        "norm_var": jnp.float32(0), "record_norm_mean": jnp.float32(0),
+        "loss_sec": jnp.float32(0), "loss_l2reg": jnp.float32(0),
+    }
+
+    def fake_update(state, images, labels, key):
+        return state, metrics
+
+    with pytest.raises(NonFiniteLossError):
+        train_one_epoch(
+            1, _FakeLoader(3, 8), fake_update, state=None, mesh=mesh,
+            base_key=jax.random.key(0), cfg=cfg, tb=None, steps_per_epoch=3,
+        )
+
+    # guard off: the same epoch completes and reports the NaN average
+    cfg_off = config_lib.SupConConfig(print_freq=1, batch_size=8, nan_guard=False)
+    _, loss_avg, _ = train_one_epoch(
+        1, _FakeLoader(3, 8), fake_update, state=None, mesh=mesh,
+        base_key=jax.random.key(0), cfg=cfg_off, tb=None, steps_per_epoch=3,
+    )
+    assert math.isnan(loss_avg)
