@@ -40,6 +40,7 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
     shard_host_batch,
 )
 from simclr_pytorch_distributed_tpu.train.linear import run_validation, stats_for, topk_correct
+from simclr_pytorch_distributed_tpu.train.supcon import enable_compile_cache
 from simclr_pytorch_distributed_tpu.utils.checkpoint import save_checkpoint
 from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
 
@@ -109,6 +110,7 @@ def make_ce_steps(model, tx, aug_cfg, mesh):
 
 def run(cfg: config_lib.LinearConfig):
     setup_distributed()
+    enable_compile_cache("auto", cfg.workdir)
     setup_logging(cfg.save_folder, is_main_process())
     mesh = create_mesh()
 

@@ -52,6 +52,7 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
     shard_host_batch,
 )
 from simclr_pytorch_distributed_tpu.train.state import make_optimizer
+from simclr_pytorch_distributed_tpu.train.supcon import enable_compile_cache
 from simclr_pytorch_distributed_tpu.utils.checkpoint import load_pretrained_variables
 from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
 
@@ -182,6 +183,7 @@ def run_validation(eval_jit, params, val_images, val_labels, batch_size, mesh):
 
 def run(cfg: config_lib.LinearConfig):
     setup_distributed()
+    enable_compile_cache("auto", cfg.workdir)
     setup_logging(cfg.save_folder, is_main_process())
     mesh = create_mesh()
 
