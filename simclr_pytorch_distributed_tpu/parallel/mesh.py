@@ -91,6 +91,40 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def tp_leaf_spec(shape, model_size: int, min_last: int = 64) -> P:
+    """Channel-wise tensor-parallel spec for one state leaf.
+
+    Shards the trailing (output-channel / feature) axis over 'model' when it
+    divides evenly and is large enough to be worth splitting. Applied uniformly
+    to params, BN running stats, and optimizer momentum (their shapes mirror
+    the params), so the whole train state partitions consistently; GSPMD
+    propagates the layouts through convs/matmuls and inserts the tensor-parallel
+    collectives. With model_size == 1 everything is replicated (the default —
+    the reference has no model parallelism, SURVEY.md §2.2).
+    """
+    if (
+        model_size > 1
+        and len(shape) > 0
+        and shape[-1] % model_size == 0
+        and shape[-1] >= min_last
+    ):
+        return P(*([None] * (len(shape) - 1)), MODEL_AXIS)
+    return P()
+
+
+def state_sharding(mesh: Mesh, state) -> "jax.tree_util.PyTreeDef":
+    """NamedSharding tree for a TrainState-like pytree under the mesh's
+    (data, model) layout: batch-independent state is model-axis sharded by
+    ``tp_leaf_spec`` and replicated over 'data'."""
+    model_size = mesh.shape.get(MODEL_AXIS, 1)
+
+    def leaf(x):
+        shape = getattr(x, "shape", ())
+        return NamedSharding(mesh, tp_leaf_spec(tuple(shape), model_size))
+
+    return jax.tree.map(leaf, state)
+
+
 def shard_host_batch(batch, mesh: Mesh):
     """Place a host batch onto the mesh, sharded along 'data'.
 

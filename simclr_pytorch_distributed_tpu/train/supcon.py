@@ -42,6 +42,7 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
     replicated_sharding,
     setup_distributed,
     shard_host_batch,
+    state_sharding,
 )
 from simclr_pytorch_distributed_tpu.train.state import (
     TrainState,
@@ -132,7 +133,7 @@ def make_fused_update(model, tx, schedule, step_cfg, aug_cfg, mesh, state_exampl
         return train_step(state, views, labels)
 
     repl = replicated_sharding(mesh)
-    state_sh = jax.tree.map(lambda _: repl, state_example)
+    state_sh = state_sharding(mesh, state_example)
     return jax.jit(
         update,
         in_shardings=(state_sh, batch_sharding(mesh, 4), batch_sharding(mesh, 1), repl),

@@ -45,6 +45,7 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
     DATA_AXIS,
     batch_sharding,
     replicated_sharding,
+    state_sharding,
 )
 from simclr_pytorch_distributed_tpu.train.state import TrainState
 
@@ -244,20 +245,17 @@ def make_sharded_train_step(
     step = make_train_step(model, tx, schedule, cfg, mesh=mesh)
     repl = replicated_sharding(mesh)
 
-    def state_sharding(s):
-        return jax.tree.map(lambda _: repl, s)
-
+    state_sh = (
+        state_sharding(mesh, state_shape) if state_shape is not None else repl
+    )
     in_shardings = (
-        state_sharding(state_shape) if state_shape is not None else repl,
+        state_sh,
         batch_sharding(mesh, 5),  # images [B, 2, H, W, C]
         batch_sharding(mesh, 1),  # labels [B]
     )
     return jax.jit(
         step,
         in_shardings=in_shardings,
-        out_shardings=(
-            state_sharding(state_shape) if state_shape is not None else repl,
-            repl,
-        ),
+        out_shardings=(state_sh, repl),
         donate_argnums=(0,) if donate else (),
     )

@@ -221,3 +221,38 @@ def test_ring_requires_mesh():
     })
     with pytest.raises(ValueError, match="needs the mesh"):
         make_train_step(model, tx, schedule, ring_cfg)
+
+
+def test_tensor_parallel_step_matches_replicated():
+    """model_parallel=4 (mesh data=2 x model=4) shards trailing channel axes
+    over 'model'; GSPMD's tensor-parallel layout must not change the math."""
+    from simclr_pytorch_distributed_tpu.parallel.mesh import state_sharding, tp_leaf_spec
+    from jax.sharding import PartitionSpec as P
+
+    assert tp_leaf_spec((3, 3, 64, 128), 4) == P(None, None, None, "model")
+    assert tp_leaf_spec((130,), 4) == P()     # not divisible
+    assert tp_leaf_spec((2048, 8), 4) == P()  # too small to split
+    assert tp_leaf_spec((64,), 1) == P()      # no model axis
+
+    model, tx, schedule, cfg, state, images, labels = tiny_setup()
+    plain_step = make_train_step(model, tx, schedule, cfg)
+    ref_state, ref_metrics = jax.jit(plain_step)(state, images, labels)
+
+    mesh = create_mesh(model_parallel=4)
+    assert mesh.shape == {"data": 2, "model": 4}
+    sharded = jax.tree.leaves(
+        jax.tree.map(lambda s: s.spec, state_sharding(mesh, state.params))
+    )
+    assert any(spec != P() for spec in sharded), "no param was TP-sharded"
+
+    step = make_sharded_train_step(
+        model, tx, schedule, cfg, mesh, state_shape=state, donate=False
+    )
+    sh_images, sh_labels = shard_host_batch((images, labels), mesh)
+    new_state, metrics = step(state, sh_images, sh_labels)
+
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(ref_metrics["loss"]), rtol=2e-5
+    )
+    for a, b in zip(jax.tree.leaves(ref_state.params), jax.tree.leaves(new_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=2e-5)
