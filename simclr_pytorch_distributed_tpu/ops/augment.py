@@ -178,9 +178,10 @@ def adjust_hue(img: jax.Array, delta: jax.Array) -> jax.Array:
     q = v * (1.0 - s * f)
     t = v * (1.0 - s * (1.0 - f))
     i = i.astype(jnp.int32) % 6
-    r2 = jnp.choose(i, [v, q, p, p, t, v], mode="clip")
-    g2 = jnp.choose(i, [t, v, v, q, p, p], mode="clip")
-    b2 = jnp.choose(i, [p, p, t, v, v, q], mode="clip")
+    # select_n lowers to pure VPU selects (jnp.choose can emit gathers)
+    r2 = jax.lax.select_n(i, v, q, p, p, t, v)
+    g2 = jax.lax.select_n(i, t, v, v, q, p, p)
+    b2 = jax.lax.select_n(i, p, p, t, v, v, q)
     return jnp.stack([r2, g2, b2], axis=-1)
 
 
