@@ -55,8 +55,5 @@ def load() -> Optional[ctypes.CDLL]:
         lib.gather_rows_i32.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
         ]
-        lib.epoch_permutation.argtypes = [
-            ctypes.c_int64, ctypes.c_uint64, ctypes.c_void_p,
-        ]
         _lib = lib
         return _lib

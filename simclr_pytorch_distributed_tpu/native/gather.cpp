@@ -33,24 +33,4 @@ void gather_rows_i32(const int32_t* src, const int64_t* idx, int64_t n_idx,
   }
 }
 
-// Fisher-Yates with SplitMix64: deterministic epoch permutation without
-// numpy's RNG overhead. Seeds match data/pipeline.py's base_seed + epoch.
-static inline uint64_t splitmix64(uint64_t* s) {
-  uint64_t z = (*s += 0x9e3779b97f4a7c15ull);
-  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
-  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
-  return z ^ (z >> 31);
-}
-
-void epoch_permutation(int64_t n, uint64_t seed, int64_t* out) {
-  for (int64_t i = 0; i < n; ++i) out[i] = i;
-  uint64_t s = seed;
-  for (int64_t i = n - 1; i > 0; --i) {
-    int64_t j = static_cast<int64_t>(splitmix64(&s) % static_cast<uint64_t>(i + 1));
-    int64_t t = out[i];
-    out[i] = out[j];
-    out[j] = t;
-  }
-}
-
 }  // extern "C"
