@@ -249,6 +249,11 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
 
     for epoch in range(start_epoch, cfg.epochs + 1):
         t1 = time.time()
+        # The update donates the incoming state's buffers, so the pre-epoch
+        # `state` object is DELETED after the first step — an un-donated
+        # on-device copy (one HBM->HBM copy per epoch) is what the crash
+        # handler can still save.
+        backup = jax.tree.map(jnp.copy, state) if cfg.nan_guard else None
         try:
             state, loss_avg, metrics = train_one_epoch(
                 epoch, loader, update_fn, state, mesh, base_key, cfg, tb,
@@ -260,7 +265,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
             # SURVEY.md §5 — absent upstream)
             if is_main_process():
                 save_checkpoint(
-                    cfg.save_folder, f"crash_epoch_{epoch}", state,
+                    cfg.save_folder, f"crash_epoch_{epoch}", backup,
                     config=config_lib.config_dict(cfg), epoch=epoch - 1,
                 )
                 logging.error("non-finite loss: saved crash_epoch_%d", epoch)
