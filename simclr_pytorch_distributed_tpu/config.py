@@ -22,7 +22,6 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import datetime
-import logging
 import os
 from typing import Optional, Tuple
 

@@ -19,7 +19,7 @@ frozen and CE is a per-example mean).
 
 from __future__ import annotations
 
-import dataclasses
+
 import logging
 import time
 from typing import Any, Tuple
