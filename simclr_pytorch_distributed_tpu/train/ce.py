@@ -41,7 +41,10 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
 )
 from simclr_pytorch_distributed_tpu.train.linear import run_validation, stats_for, topk_correct
 from simclr_pytorch_distributed_tpu.train.supcon import enable_compile_cache
-from simclr_pytorch_distributed_tpu.utils.checkpoint import save_checkpoint
+from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+    save_checkpoint,
+    wait_for_saves,
+)
 from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
 
 
@@ -193,9 +196,11 @@ def run(cfg: config_lib.LinearConfig):
             save_checkpoint(
                 cfg.save_folder, f"ckpt_epoch_{epoch}",
                 # CEState quacks enough like TrainState for the saver
-                state_for_save(state), config=config_lib.config_dict(cfg), epoch=epoch,
+                state_for_save(state), config=config_lib.config_dict(cfg),
+                epoch=epoch, block=False,
             )
 
+    wait_for_saves()
     logging.info("best accuracy: %.2f, accuracy5: %.2f", best_acc, best_acc5)
     tb.close()
     return best_acc, best_acc5

@@ -57,6 +57,7 @@ from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     load_pretrained_variables,
     restore_checkpoint,
     save_checkpoint,
+    wait_for_saves,
 )
 from simclr_pytorch_distributed_tpu.utils.guard import (
     NonFiniteLossError,
@@ -276,11 +277,14 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
             tb.log_value("loss", loss_avg, epoch)
             tb.log_value("learning_rate", float(schedule((epoch - 1) * steps_per_epoch)), epoch)
             if epoch % cfg.save_freq == 0:
+                # async write: D2H serialization is synchronous (safe with
+                # buffer donation), the disk write overlaps the next epochs
                 save_checkpoint(
                     cfg.save_folder, f"ckpt_epoch_{epoch}", state,
-                    config=config_lib.config_dict(cfg), epoch=epoch,
+                    config=config_lib.config_dict(cfg), epoch=epoch, block=False,
                 )
     if is_main_process():
+        wait_for_saves()
         save_checkpoint(
             cfg.save_folder, "last", state,
             config=config_lib.config_dict(cfg), epoch=cfg.epochs,

@@ -25,23 +25,41 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
 META_FILE = "meta.json"
 
+# checkpointers whose background write is still in flight (block=False saves)
+_PENDING: List[ocp.StandardCheckpointer] = []
+
 
 def _abstract(tree):
     return jax.tree.map(ocp.utils.to_shape_dtype_struct, tree)
 
 
-def _save_tree(path: str, tree) -> None:
+def _save_tree(path: str, tree, block: bool = True) -> None:
+    """Orbax save. The D2H serialization is always synchronous (so donated
+    device buffers are safe to reuse immediately), but with ``block=False`` the
+    disk write continues in a background thread — call ``wait_for_saves()``
+    before reading the checkpoint back or exiting."""
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, tree, force=True)
-    ckptr.wait_until_finished()
-    ckptr.close()
+    if block:
+        ckptr.wait_until_finished()
+        ckptr.close()
+    else:
+        _PENDING.append(ckptr)
+
+
+def wait_for_saves() -> None:
+    """Drain all in-flight background checkpoint writes."""
+    while _PENDING:
+        c = _PENDING.pop()
+        c.wait_until_finished()
+        c.close()
 
 
 def _restore_tree(path: str, abstract_tree):
@@ -53,13 +71,19 @@ def _restore_tree(path: str, abstract_tree):
 
 def save_checkpoint(
     save_folder: str, name: str, state, config: Optional[dict] = None,
-    epoch: Optional[int] = None,
+    epoch: Optional[int] = None, block: bool = True,
 ) -> str:
-    """Write ``{save_folder}/{name}`` (ckpt_epoch_N / last naming upstream)."""
+    """Write ``{save_folder}/{name}`` (ckpt_epoch_N / last naming upstream).
+
+    ``block=False`` overlaps the disk write with subsequent training (the
+    reference's ``torch.save`` stalls the epoch loop); the driver drains
+    pending writes via ``wait_for_saves()`` before the final save/exit.
+    """
     path = os.path.abspath(os.path.join(save_folder, name))
     _save_tree(
         os.path.join(path, "model"),
         {"params": state.params, "batch_stats": state.batch_stats},
+        block=block,
     )
     _save_tree(
         os.path.join(path, "train"),
@@ -68,6 +92,7 @@ def save_checkpoint(
             "step": state.step,
             "record_norm_mean": state.record_norm_mean,
         },
+        block=block,
     )
     meta = {"epoch": epoch, "config": config or {}}
     with open(os.path.join(path, META_FILE), "w") as f:

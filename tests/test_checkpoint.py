@@ -57,3 +57,16 @@ def test_model_only_warm_start(tmp_path):
         jax.tree.leaves(state.batch_stats), jax.tree.leaves(variables["batch_stats"])
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_async_save_roundtrip(tmp_path):
+    """block=False saves complete after wait_for_saves() and restore exactly."""
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import wait_for_saves
+
+    _, _, state = small_state()
+    save_checkpoint(str(tmp_path), "async_ck", state, epoch=3, block=False)
+    wait_for_saves()
+    restored, meta = restore_checkpoint(str(tmp_path / "async_ck"), state)
+    assert meta["epoch"] == 3
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
