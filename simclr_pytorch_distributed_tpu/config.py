@@ -74,7 +74,8 @@ class SupConConfig:
     model_parallel: int = 1
     seed: int = 0
     workdir: str = "./work_space"
-    tb_every: int = 10  # per-iter TB cadence (reference logs every iter)
+    # NOTE: per-iter TB scalars follow --print_freq (the reference logs every
+    # iter, which forces a device sync per step)
     # contrastive-loss implementation: 'auto' picks the fused Pallas kernel on
     # a single TPU chip, the dense XLA path otherwise (ops/pallas_loss.py);
     # 'ring' streams contrast blocks around the data axis with ppermute
@@ -143,7 +144,6 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--model_parallel", type=int, default=d.model_parallel)
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--workdir", type=str, default=d.workdir)
-    p.add_argument("--tb_every", type=int, default=d.tb_every)
     p.add_argument("--loss_impl", type=str, default=d.loss_impl,
                    choices=["auto", "dense", "fused", "ring"])
     p.add_argument("--trace_dir", type=str, default=d.trace_dir,
