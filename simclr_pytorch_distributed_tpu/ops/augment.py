@@ -73,6 +73,11 @@ def crop_and_resize(
     d = jnp.arange(out_size, dtype=jnp.float32)
     ys = top + (d + 0.5) * (h / out_size) - 0.5
     xs = left + (d + 0.5) * (w / out_size) - 0.5
+    # clamp to the CROP box, not the image: PIL/torchvision resize a cropped
+    # image, so border samples replicate the crop edge rather than bleeding
+    # into pixels outside the crop (verified against PIL in test_augment).
+    ys = jnp.clip(ys, top, top + h - 1.0)
+    xs = jnp.clip(xs, left, left + w - 1.0)
     wy = _interp_matrix(ys, H)  # [out, H]
     wx = _interp_matrix(xs, W)  # [out, W]
     rows = jnp.einsum("sh,hwc->swc", wy, img)

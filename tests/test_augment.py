@@ -179,3 +179,28 @@ def test_augment_batch_no_color_ops(rng):
     out = np.asarray(augment_batch(jax.random.key(0), jnp.asarray(imgs), cfg))
     want = (gray_val / 255.0 - np.array(cfg.mean)) / np.array(cfg.std)
     np.testing.assert_allclose(out, np.broadcast_to(want, out.shape), atol=1e-4)
+
+
+def test_crop_resize_matches_pil_bilinear(rng):
+    """Golden fidelity vs the reference's actual host path: torchvision's
+    RandomResizedCrop = PIL crop().resize(BILINEAR). PIL computes in fixed
+    point, so agreement is ~1-2/255. Covers interior crops (border samples
+    must replicate the CROP edge, not bleed into the surrounding image)."""
+    from PIL import Image
+
+    img = rng.integers(0, 256, size=(8, 8, 3), dtype=np.uint8)
+    cases = [  # (PIL box (l,u,r,low), (top,left,h,w), out)
+        ((0, 0, 8, 8), (0.0, 0.0, 8.0, 8.0), 16),
+        ((1, 2, 6, 7), (2.0, 1.0, 5.0, 5.0), 32),
+        ((3, 1, 7, 8), (1.0, 3.0, 7.0, 4.0), 20),
+    ]
+    for box, (top, left, h, w), out in cases:
+        pil = np.asarray(
+            Image.fromarray(img).crop(box).resize((out, out), Image.BILINEAR),
+            np.float32,
+        ) / 255.0
+        ours = np.asarray(
+            crop_and_resize(jnp.asarray(img, jnp.float32) / 255.0,
+                            top, left, h, w, out)
+        )
+        np.testing.assert_allclose(ours, pil, atol=2.0 / 255.0)
