@@ -1,3 +1,7 @@
-from simclr_pytorch_distributed_tpu.ops.losses import supcon_loss  # noqa: F401
+from simclr_pytorch_distributed_tpu.ops.losses import (  # noqa: F401
+    cross_entropy_loss,
+    supcon_loss,
+)
+from simclr_pytorch_distributed_tpu.ops.pallas_loss import fused_supcon_loss  # noqa: F401
 from simclr_pytorch_distributed_tpu.ops import schedules  # noqa: F401
 from simclr_pytorch_distributed_tpu.ops import metrics  # noqa: F401

@@ -5,4 +5,10 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (  # noqa: F401
     replicated_sharding,
     setup_distributed,
     shard_host_batch,
+    state_sharding,
+    tp_leaf_spec,
+)
+from simclr_pytorch_distributed_tpu.parallel.collectives import (  # noqa: F401
+    gather_global_labels,
+    ring_supcon_loss,
 )

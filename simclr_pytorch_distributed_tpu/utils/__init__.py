@@ -2,7 +2,13 @@ from simclr_pytorch_distributed_tpu.utils.checkpoint import (  # noqa: F401
     load_pretrained_variables,
     restore_checkpoint,
     save_checkpoint,
+    wait_for_saves,
 )
+from simclr_pytorch_distributed_tpu.utils.guard import (  # noqa: F401
+    NonFiniteLossError,
+    check_finite_loss,
+)
+from simclr_pytorch_distributed_tpu.utils.profiling import StepTracer  # noqa: F401
 from simclr_pytorch_distributed_tpu.utils.logging_utils import (  # noqa: F401
     TBLogger,
     setup_logging,
