@@ -204,3 +204,41 @@ def test_crop_resize_matches_pil_bilinear(rng):
                             top, left, h, w, out)
         )
         np.testing.assert_allclose(ours, pil, atol=2.0 / 255.0)
+
+
+def test_color_ops_match_pil(rng):
+    """Fixed-factor goldens vs PIL ImageEnhance / HSV — the code paths
+    torchvision's ColorJitter actually executes on the reference's host.
+    Brightness/contrast/saturation agree within uint8 quantization; hue is
+    looser because PIL shifts a hue channel quantized to 256 levels while the
+    device op is continuous."""
+    from PIL import Image, ImageEnhance
+
+    img = rng.integers(0, 256, size=(16, 16, 3), dtype=np.uint8)
+    pim = Image.fromarray(img)
+    x = jnp.asarray(img, jnp.float32) / 255.0
+    f = 1.3
+
+    for name, pil_out, ours in [
+        ("brightness", ImageEnhance.Brightness(pim).enhance(f),
+         adjust_brightness(x, f)),
+        ("contrast", ImageEnhance.Contrast(pim).enhance(f),
+         adjust_contrast(x, f)),
+        ("saturation", ImageEnhance.Color(pim).enhance(f),
+         adjust_saturation(x, f)),
+    ]:
+        ref = np.asarray(pil_out, np.float32) / 255.0
+        np.testing.assert_allclose(
+            np.asarray(ours), ref, atol=1.5 / 255.0, err_msg=name
+        )
+
+    delta = 0.05
+    h, s, v = pim.convert("HSV").split()
+    h = h.point(lambda p: (p + int(delta * 255)) % 256)
+    hue_ref = np.asarray(
+        Image.merge("HSV", (h, s, v)).convert("RGB"), np.float32
+    ) / 255.0
+    np.testing.assert_allclose(
+        np.asarray(adjust_hue(x, delta)), hue_ref, atol=10.0 / 255.0,
+        err_msg="hue",
+    )
