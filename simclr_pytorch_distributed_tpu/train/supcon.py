@@ -193,8 +193,13 @@ def train_one_epoch(
 
 
 def enable_compile_cache(compile_cache: str, workdir: str) -> None:
-    """Persistent XLA compile cache: restarts/resumes skip the cold compile."""
-    if not compile_cache:
+    """Persistent XLA compile cache: restarts/resumes skip the cold compile.
+
+    A cache dir already configured (tests' shared ``.jax_cache``, or a user's
+    own setting) wins — overriding it with a per-workdir path would throw the
+    warm cache away.
+    """
+    if not compile_cache or jax.config.jax_compilation_cache_dir:
         return
     path = (
         os.path.join(workdir, ".jax_cache") if compile_cache == "auto"

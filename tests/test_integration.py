@@ -70,13 +70,16 @@ def test_supcon_resume(tmp_path):
 
 
 def test_ce_driver_end_to_end(tmp_path):
+    # lr 0.1: the old lr=0.5 was on the edge of divergence for a from-scratch
+    # CNN on 280 samples — tiny numeric perturbations (e.g. a changed augment
+    # fusion) flipped the trajectory between ~8% and ~20% val top-1. At lr 0.1
+    # / 10 epochs the margin is wide: 60-82% across seeds.
     cfg = config_lib.LinearConfig(
-        model="resnet18", dataset="synthetic", batch_size=64, epochs=6,
-        learning_rate=0.5, size=SIZE, val_batch_size=40, workdir=str(tmp_path),
-        print_freq=2,
+        model="resnet18", dataset="synthetic", batch_size=64, epochs=10,
+        learning_rate=0.1, size=SIZE, val_batch_size=40, workdir=str(tmp_path),
+        print_freq=100,
     )
     cfg = config_lib.finalize_linear(cfg, prefix="ce_")
     best_acc, best_acc5 = ce_driver.run(cfg)
-    # training a CNN from scratch on 280 synthetic samples: expect clearly
-    # above chance (10% top-1 / 50% top-5) but not much more
-    assert best_acc > 15.0, (best_acc, best_acc5)
+    assert best_acc > 30.0, (best_acc, best_acc5)
+    assert best_acc5 >= best_acc
