@@ -50,6 +50,9 @@ class SupConConfig:
     std: Optional[str] = None
     data_folder: Optional[str] = None
     size: int = 32
+    # 'path' datasets: host-side storage resolution (0 = 2*size); the device
+    # RandomResizedCrop samples from this resolution (data/folder.py)
+    store_size: int = 0
     # method (main_supcon.py:58-64)
     method: str = "SimCLR"  # {SupCon, SimCLR}
     temp: float = 0.5
@@ -124,6 +127,8 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--std", type=str, default=None)
     p.add_argument("--data_folder", type=str, default=None)
     p.add_argument("--size", type=int, default=d.size)
+    p.add_argument("--store_size", type=int, default=d.store_size,
+                   help="path datasets: stored resolution (0 = 2*size)")
     p.add_argument("--method", type=str, default=d.method, choices=["SupCon", "SimCLR"])
     p.add_argument("--temp", type=float, default=d.temp)
     _add_bool_flag(p, "cosine")

@@ -88,6 +88,7 @@ def load_dataset(
     data_folder: str,
     allow_synthetic_fallback: bool = False,
     size: int = 32,
+    store_size: int = 0,
 ) -> Tuple[NumpyDataset, NumpyDataset, int]:
     """Returns (train, test, num_classes). ``dataset`` in {cifar10, cifar100,
     path, synthetic}; with ``allow_synthetic_fallback`` a missing on-disk
@@ -100,7 +101,9 @@ def load_dataset(
     if dataset == "path":
         from simclr_pytorch_distributed_tpu.data.folder import load_image_folder
 
-        train, classes = load_image_folder(data_folder, size=size)
+        train, classes = load_image_folder(
+            data_folder, size=size, store_size=store_size or None
+        )
         # no val split in the reference's path mode; empty test set
         empty = {
             "images": train["images"][:0],
