@@ -101,7 +101,9 @@ def ring_supcon_loss(
 
     def dev_varying(x):
         # mark fresh accumulators as device-varying for shard_map's vma typing
-        return jax.lax.pvary(x, (axis_name,))
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(x, (axis_name,), to="varying")
+        return jax.lax.pvary(x, (axis_name,))  # older jax
 
     init = (
         feats_local,
