@@ -106,6 +106,15 @@ def _add_bool_flag(parser, name, default=False, help=""):
     parser.add_argument(f"--{name}", action="store_true", default=default, help=help)
 
 
+def _parse_bool(s: str) -> bool:
+    v = s.lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected a boolean, got {s!r}")
+
+
 def supcon_parser() -> argparse.ArgumentParser:
     d = SupConConfig()
     p = argparse.ArgumentParser("argument for training")
@@ -156,7 +165,7 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace_start_step", type=int, default=d.trace_start_step)
     p.add_argument("--trace_steps", type=int, default=d.trace_steps)
     p.add_argument("--compile_cache", type=str, default=d.compile_cache)
-    p.add_argument("--nan_guard", type=lambda s: s.lower() not in ("0", "false"),
+    p.add_argument("--nan_guard", type=_parse_bool,
                    default=d.nan_guard, help="abort + checkpoint on NaN loss")
     return p
 
@@ -233,6 +242,7 @@ class LinearConfig:
     seed: int = 0
     workdir: str = "./work_space"
     trial: str = "0"
+    compile_cache: str = "auto"  # same semantics as the pretrain flag
     # derived
     n_cls: int = 10
     warm_epochs: int = 10
@@ -270,6 +280,7 @@ def linear_parser(ce: bool = False) -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--workdir", type=str, default=d.workdir)
     p.add_argument("--trial", type=str, default=d.trial)
+    p.add_argument("--compile_cache", type=str, default=d.compile_cache)
     return p
 
 

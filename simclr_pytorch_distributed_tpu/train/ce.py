@@ -113,7 +113,7 @@ def make_ce_steps(model, tx, aug_cfg, mesh):
 
 def run(cfg: config_lib.LinearConfig):
     setup_distributed()
-    enable_compile_cache("auto", cfg.workdir)
+    enable_compile_cache(cfg.compile_cache, cfg.workdir)
     setup_logging(cfg.save_folder, is_main_process())
     mesh = create_mesh()
 

@@ -183,7 +183,7 @@ def run_validation(eval_jit, params, val_images, val_labels, batch_size, mesh):
 
 def run(cfg: config_lib.LinearConfig):
     setup_distributed()
-    enable_compile_cache("auto", cfg.workdir)
+    enable_compile_cache(cfg.compile_cache, cfg.workdir)
     setup_logging(cfg.save_folder, is_main_process())
     mesh = create_mesh()
 

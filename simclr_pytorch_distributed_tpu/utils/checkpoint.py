@@ -79,6 +79,10 @@ def save_checkpoint(
     reference's ``torch.save`` stalls the epoch loop); the driver drains
     pending writes via ``wait_for_saves()`` before the final save/exit.
     """
+    if not block:
+        # bound resources to one in-flight save: the previous async write
+        # (a save_freq of epochs ago) has long finished, so this is ~free
+        wait_for_saves()
     path = os.path.abspath(os.path.join(save_folder, name))
     _save_tree(
         os.path.join(path, "model"),
