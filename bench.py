@@ -6,14 +6,50 @@ forward/backward + global NT-Xent + SGD) at the published recipe config
 (bs=256 global, 32x32, temp 0.5, SyncBN) on the available chips and prints ONE
 JSON line. The reference publishes no throughput numbers (BASELINE.json
 ``published`` is empty), so ``vs_baseline`` is reported as 1.0.
+
+Honesty guard: on the tunneled bench chip, ``jax.block_until_ready`` returns
+BEFORE the computation actually finishes (the tunnel acks buffer readiness
+early), which made round-1 numbers physically impossible (implied MFU ~600%+).
+The only trustworthy sync is a host readback of a *computed scalar*
+(``float(metrics["loss"])``) — that value cannot exist until the step ran.
+Each timing window ends with such a readback. On top of that, every window's
+throughput is cross-checked against the program's XLA FLOP count and the
+chip's peak: windows whose implied MFU exceeds ``CREDIBLE_MFU`` are discarded
+as clock glitches, and the headline is the **median** of the credible windows —
+never a best-of-N, which selects exactly the most-wrong samples.
 """
 
 import json
+import statistics
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# Peak dense bf16 throughput assumed for MFU accounting, by device kind.
+# v5e ("TPU v5 lite"): 197 TFLOP/s bf16 (public spec). CPU fallback is only so
+# the script runs everywhere; its MFU is not meaningful.
+PEAK_TFLOPS_BY_KIND = {
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v4": 275.0,
+    "TPU v5p": 459.0,
+    "TPU v6e": 918.0,
+}
+DEFAULT_PEAK_TFLOPS = 197.0
+CREDIBLE_MFU = 0.70  # anything above this on this workload is a clock glitch
+
+
+def _flops_per_step(update, *example_args) -> float:
+    """XLA's own FLOP count for one compiled update step (0.0 if unavailable)."""
+    try:
+        cost = update.lower(*example_args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        return float(cost.get("flops", 0.0))
+    except Exception:
+        return 0.0
 
 
 def main():
@@ -28,10 +64,15 @@ def main():
         create_train_state,
         make_optimizer,
     )
-    from simclr_pytorch_distributed_tpu.train.supcon import make_fused_update
+    from simclr_pytorch_distributed_tpu.train.supcon import (
+        make_fused_update,
+        resolve_loss_impl,
+    )
     from simclr_pytorch_distributed_tpu.train.supcon_step import SupConStepConfig
 
     n_chips = len(jax.devices())
+    device_kind = jax.devices()[0].device_kind
+    peak_tflops = PEAK_TFLOPS_BY_KIND.get(device_kind, DEFAULT_PEAK_TFLOPS)
     mesh = create_mesh()
     batch, size = 256, 32
     steps_per_epoch = 50000 // batch
@@ -47,8 +88,6 @@ def main():
     state = create_train_state(
         model, tx, jax.random.key(0), jnp.zeros((2, size, size, 3))
     )
-    from simclr_pytorch_distributed_tpu.train.supcon import resolve_loss_impl
-
     loss_impl = resolve_loss_impl("auto", batch, n_chips)
     step_cfg = SupConStepConfig(
         method="SimCLR", temperature=0.5, epochs=100,
@@ -63,28 +102,55 @@ def main():
     labels = rng.integers(0, 10, size=(batch,)).astype(np.int32)
     sh_images, sh_labels = shard_host_batch((images, labels), mesh)
 
-    # warmup (compile + first steps)
+    flops = _flops_per_step(update, state, sh_images, sh_labels, jax.random.key(0))
+
+    # warmup (compile + first steps); scalar readback = real sync (docstring)
     for i in range(3):
         state, metrics = update(state, sh_images, sh_labels, jax.random.key(i))
-    jax.block_until_ready(state.params)
+    float(metrics["loss"])
 
-    # best-of-5 20-step windows: the tunneled chip is shared, so a single
-    # window can be skewed by co-tenant load; the fastest window is the
-    # closest estimate of the hardware's actual step time.
-    n_steps, windows = 20, 5
-    best_dt = float("inf")
+    # Median of credible windows (see module docstring for why not best-of-N).
+    n_steps, windows = 30, 5
+    window_dts = []
     for w in range(windows):
         t0 = time.perf_counter()
         for i in range(n_steps):
             state, metrics = update(
                 state, sh_images, sh_labels, jax.random.key(100 + w * n_steps + i)
             )
-        jax.block_until_ready(state.params)
-        best_dt = min(best_dt, time.perf_counter() - t0)
-    dt = best_dt
+        float(metrics["loss"])  # D2H readback of a computed value: real sync
+        window_dts.append(time.perf_counter() - t0)
+
+    def implied_mfu(dt_window: float) -> float:
+        if flops <= 0:
+            return 0.0
+        return (flops * n_steps / dt_window) / (peak_tflops * 1e12 * n_chips)
+
+    if flops <= 0:
+        # No FLOP count -> the MFU cross-check cannot run, so the number
+        # cannot be certified against the round-1 failure mode. Report the
+        # slowest (most conservative) window and flag it.
+        credible = []
+        n_glitched = 0
+        dt = max(window_dts)
+        clock_suspect = True
+    else:
+        credible = [dt for dt in window_dts if implied_mfu(dt) <= CREDIBLE_MFU]
+        n_glitched = len(window_dts) - len(credible)
+        if credible:
+            dt = statistics.median(credible)
+            clock_suspect = False
+        else:
+            # Every window claims impossible speed: the clock cannot be
+            # trusted at all. Report the SLOWEST window (the most
+            # conservative sample) and flag it, rather than quoting a number
+            # we know is wrong.
+            dt = max(window_dts)
+            clock_suspect = True
 
     imgs_per_sec = n_steps * batch / dt
     per_chip = imgs_per_sec / n_chips
+    mfu = implied_mfu(dt)
     print(json.dumps({
         "metric": "pretrain_imgs_per_sec_per_chip",
         "value": round(per_chip, 1),
@@ -93,8 +159,16 @@ def main():
         "detail": {
             "global_batch": batch,
             "chips": n_chips,
+            "device_kind": device_kind,
             "total_imgs_per_sec": round(imgs_per_sec, 1),
             "step_ms": round(1000 * dt / n_steps, 2),
+            "flops_per_step": flops,
+            "implied_mfu": round(mfu, 4),
+            "peak_tflops_assumed": peak_tflops,
+            "window_step_ms": [round(1000 * d / n_steps, 2) for d in window_dts],
+            "windows_discarded_as_clock_glitch": n_glitched,
+            "clock_suspect": clock_suspect,
+            "selection": "median of credible windows (implied MFU <= 0.7)",
             "config": f"SimCLR rn50 cifar-recipe bf16 fused-aug loss={loss_impl}",
         },
     }))
