@@ -45,7 +45,7 @@ class SupConConfig:
     momentum: float = 0.9
     # model / dataset (main_supcon.py:49-56)
     model: str = "resnet50"
-    dataset: str = "cifar10"  # {cifar10, cifar100, path, synthetic}
+    dataset: str = "cifar10"  # {cifar10, cifar100, path, synthetic, synthetic_hard}
     mean: Optional[str] = None
     std: Optional[str] = None
     data_folder: Optional[str] = None
@@ -130,7 +130,7 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--model", type=str, default=d.model)
     p.add_argument("--dataset", type=str, default=d.dataset,
-                   choices=["cifar10", "cifar100", "path", "synthetic"])
+                   choices=["cifar10", "cifar100", "path", "synthetic", "synthetic_hard"])
     p.add_argument("--mean", type=str, default=None,
                    help="mean of dataset in path in form of str tuple")
     p.add_argument("--std", type=str, default=None)
@@ -230,7 +230,7 @@ class LinearConfig:
     weight_decay: float = 0.0
     momentum: float = 0.9
     model: str = "resnet50"
-    dataset: str = "cifar10"  # {cifar10, cifar100, synthetic}
+    dataset: str = "cifar10"  # {cifar10, cifar100, synthetic, synthetic_hard}
     cosine: bool = False
     warm: bool = False
     ckpt: str = ""
@@ -268,7 +268,7 @@ def linear_parser(ce: bool = False) -> argparse.ArgumentParser:
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--model", type=str, default=d.model)
     p.add_argument("--dataset", type=str, default=d.dataset,
-                   choices=["cifar10", "cifar100", "synthetic"])
+                   choices=["cifar10", "cifar100", "synthetic", "synthetic_hard"])
     _add_bool_flag(p, "cosine")
     _add_bool_flag(p, "warm")
     if not ce:
@@ -309,7 +309,7 @@ def finalize_linear(
         cfg.warmup_to = warmup_to_value(
             cfg.learning_rate, cfg.lr_decay_rate, cfg.warm_epochs, cfg.epochs, cfg.cosine
         )
-    cfg.n_cls = {"cifar10": 10, "cifar100": 100, "synthetic": 10}[cfg.dataset]
+    cfg.n_cls = {"cifar10": 10, "cifar100": 100, "synthetic": 10, "synthetic_hard": 10}[cfg.dataset]
 
     now_time = datetime.datetime.now().strftime("%m%d_%H%M")
     run = prefix + now_time + "_"

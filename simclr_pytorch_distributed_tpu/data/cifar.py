@@ -83,6 +83,53 @@ def synthetic_dataset(
     return train, test
 
 
+def synthetic_texture_dataset(
+    n: int = 14336, num_classes: int = 10, seed: int = 0, size: int = 32
+) -> Tuple[NumpyDataset, NumpyDataset]:
+    """Class-by-texture synthetic data for accuracy experiments (RESULTS.md).
+
+    Class k is a plaid: two superimposed gratings at orientations theta_k and
+    pi - theta_k (theta_k = (k+0.5) * (pi/2) / C), each with independent random
+    phase, plus frequency jitter, random per-channel color gain/offset, and
+    pixel noise. Design properties:
+
+    - Horizontal flip maps orientation theta -> pi - theta, i.e. it swaps the
+      two gratings of the SAME class: the class is closed under the aug
+      stack's flip (unlike single-orientation classes, which flips merge).
+    - Crop/resize preserves orientation; ColorJitter/grayscale only touch
+      color, which is nuisance here. So the class signal survives the SimCLR
+      augmentations while color (the easy shortcut) carries no signal.
+    - Random phases decorrelate individual pixels from the class
+      (E[pixel | class] is constant), so a LINEAR probe on raw pixels stays
+      near chance — probe accuracy on frozen features measures what the
+      encoder actually learned, unlike ``synthetic_dataset``'s color-mean
+      classes (trivially pixel-separable, and destroyed by ColorJitter).
+    """
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    yy, xx = np.mgrid[0:size, 0:size]
+    xx = xx.astype(np.float32)[None]  # [1, H, W]
+    yy = yy.astype(np.float32)[None]
+    theta = (labels + 0.5) * (np.pi / 2) / num_classes  # in (0, pi/2)
+    cos_t = np.cos(theta)[:, None, None]
+    sin_t = np.sin(theta)[:, None, None]
+    freq = rng.uniform(2.5, 3.5, size=(n, 1, 1)) * (2 * np.pi / size)
+    phase1 = rng.uniform(0, 2 * np.pi, size=(n, 1, 1))
+    phase2 = rng.uniform(0, 2 * np.pi, size=(n, 1, 1))
+    # grating 1 at theta, grating 2 at pi - theta (h-flip swaps them)
+    wave = np.sin(freq * (cos_t * xx + sin_t * yy) + phase1) + np.sin(
+        freq * (-cos_t * xx + sin_t * yy) + phase2
+    )  # [n, H, W] in [-2, 2]
+    base = rng.uniform(80, 176, size=(n, 1, 1, 3))
+    gain = rng.uniform(16, 32, size=(n, 1, 1, 3))
+    img = base + gain * wave[..., None] + rng.normal(0, 10, size=(n, size, size, 3))
+    images = np.clip(img, 0, 255).astype(np.uint8)
+    k = max(n // 8, 1)
+    train = {"images": images[k:], "labels": labels[k:]}
+    test = {"images": images[:k], "labels": labels[:k]}
+    return train, test
+
+
 def load_dataset(
     dataset: str,
     data_folder: str,
@@ -91,7 +138,7 @@ def load_dataset(
     store_size: int = 0,
 ) -> Tuple[NumpyDataset, NumpyDataset, int]:
     """Returns (train, test, num_classes). ``dataset`` in {cifar10, cifar100,
-    path, synthetic}; with ``allow_synthetic_fallback`` a missing on-disk
+    path, synthetic, synthetic_hard}; with ``allow_synthetic_fallback`` a missing on-disk
     dataset degrades to synthetic data with a warning (benchmark environments).
     ``path`` reads an ImageFolder-style class-per-subdir tree (train split
     only, like the reference main_supcon.py:189-191); ``size`` sets its
@@ -116,6 +163,9 @@ def load_dataset(
         n_cls, loader, marker = 100, load_cifar100, "cifar-100-python"
     elif dataset == "synthetic":
         train, test = synthetic_dataset()
+        return train, test, 10
+    elif dataset == "synthetic_hard":
+        train, test = synthetic_texture_dataset()
         return train, test, 10
     else:
         raise ValueError(f"dataset not supported: {dataset}")

@@ -70,7 +70,7 @@ from simclr_pytorch_distributed_tpu.utils.profiling import StepTracer
 def make_augment_config(cfg: config_lib.SupConConfig, color_ops: bool = True) -> AugmentConfig:
     if cfg.dataset in DATASET_STATS:
         mean, std = DATASET_STATS[cfg.dataset]
-    elif cfg.dataset == "synthetic":
+    elif cfg.dataset.startswith("synthetic"):
         mean, std = ((0.5, 0.5, 0.5), (0.25, 0.25, 0.25))
     else:  # 'path' datasets: user-supplied strings (reference main_supcon.py:163-165,
         # minus its std=eval(mean) bug)
