@@ -47,3 +47,39 @@ class AverageMeter:
         self.sum += val * n
         self.count += n
         self.avg = self.sum / self.count
+
+
+class MetricBuffer:
+    """Buffers per-step device metric dicts; fetches them in ONE batched
+    device->host transfer on ``flush()``.
+
+    The reference reads ``loss.item()`` every iteration (main_supcon.py:320) —
+    a sync point that stalls dispatch. Fetching only every ``print_freq`` steps
+    (round-1 behavior) kept dispatch async but subsampled the meters/TB curves
+    to ~1/print_freq of the steps. Buffering gives both: every step is metered
+    and TB-logged at reference cadence, with one transfer per flush instead of
+    one per step.
+    """
+
+    def __init__(self) -> None:
+        self._steps = []  # (step_info, {name: device scalar})
+
+    def append(self, info, metrics: dict) -> None:
+        self._steps.append((info, metrics))
+
+    def flush(self):
+        """Returns [(info, {name: float})] for all buffered steps; clears."""
+        import numpy as np
+
+        if not self._steps:
+            return []
+        keys = sorted(self._steps[0][1])
+        stacked = np.asarray(
+            jnp.stack([jnp.stack([m[k] for k in keys]) for _, m in self._steps])
+        )  # [n_steps, n_keys] — a single readback
+        out = [
+            (info, dict(zip(keys, (float(v) for v in row))))
+            for (info, _), row in zip(self._steps, stacked)
+        ]
+        self._steps = []
+        return out

@@ -29,7 +29,7 @@ from simclr_pytorch_distributed_tpu.ops.augment import (
     eval_batch,
 )
 from simclr_pytorch_distributed_tpu.ops.losses import cross_entropy_loss
-from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
+from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter, MetricBuffer
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
     batch_sharding,
@@ -164,18 +164,27 @@ def run(cfg: config_lib.LinearConfig):
     for epoch in range(1, cfg.epochs + 1):
         t1 = time.time()
         losses, top1 = AverageMeter(), AverageMeter()
+        buffer = MetricBuffer()
+
+        def fold_metrics():
+            # one batched readback; every step reaches the meters
+            for _, m in buffer.flush():
+                losses.update(m["loss"], cfg.batch_size)
+                top1.update(100.0 * m["top1"] / cfg.batch_size, cfg.batch_size)
+
         for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
             key = jax.random.fold_in(base_key, (epoch - 1) * steps_per_epoch + idx)
             batch = shard_host_batch((images_u8, labels), mesh)
             state, m = train_jit(state, batch[0], batch[1], key)
+            buffer.append(idx, m)
             if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
-                losses.update(float(m["loss"]), cfg.batch_size)
-                top1.update(100.0 * float(m["top1"]) / cfg.batch_size, cfg.batch_size)
+                fold_metrics()
                 logging.info(
                     "Train: [%d][%d/%d]\tloss %.3f (%.3f)\tAcc@1 %.3f (%.3f)",
                     epoch, idx + 1, steps_per_epoch,
                     losses.val, losses.avg, top1.val, top1.avg,
                 )
+        fold_metrics()
         logging.info("Train epoch %d, total time %.2f, accuracy:%.2f",
                      epoch, time.time() - t1, top1.avg)
 

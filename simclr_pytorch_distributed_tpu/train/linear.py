@@ -33,7 +33,11 @@ from flax import struct
 from simclr_pytorch_distributed_tpu import config as config_lib
 from simclr_pytorch_distributed_tpu.data.cifar import load_dataset
 from simclr_pytorch_distributed_tpu.data.pipeline import EpochLoader
-from simclr_pytorch_distributed_tpu.models import LinearClassifier, SupConResNet
+from simclr_pytorch_distributed_tpu.models import (
+    MODEL_DICT,
+    LinearClassifier,
+    SupConResNet,
+)
 from simclr_pytorch_distributed_tpu.ops.augment import (
     DATASET_STATS,
     AugmentConfig,
@@ -41,7 +45,7 @@ from simclr_pytorch_distributed_tpu.ops.augment import (
     eval_batch,
 )
 from simclr_pytorch_distributed_tpu.ops.losses import cross_entropy_loss
-from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
+from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter, MetricBuffer
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
     batch_sharding,
@@ -80,7 +84,7 @@ def build_probe(cfg: config_lib.LinearConfig, steps_per_epoch: int, encoder_vari
         warm=cfg.warm, warm_epochs=cfg.warm_epochs, warmup_from=cfg.warmup_from,
     )
     tx = make_optimizer(schedule, momentum=cfg.momentum, weight_decay=cfg.weight_decay)
-    feat_dim = {"resnet18": 512, "resnet34": 512}.get(cfg.model, 2048)
+    feat_dim = MODEL_DICT[cfg.model][1]
     cls_params = classifier.init(
         jax.random.key(cfg.seed), jnp.zeros((2, feat_dim))
     )["params"]
@@ -233,16 +237,24 @@ def run(cfg: config_lib.LinearConfig):
         t1 = time.time()
         losses, top1, top5 = AverageMeter(), AverageMeter(), AverageMeter()
         bt = AverageMeter()
+        buffer = MetricBuffer()
+        bsz = cfg.batch_size
+
+        def fold_metrics():
+            # one batched readback; every step reaches the meters
+            for _, m in buffer.flush():
+                losses.update(m["loss"], bsz)
+                top1.update(100.0 * m["top1"] / bsz, bsz)
+                top5.update(100.0 * m["top5"] / bsz, bsz)
+
         end = time.time()
         for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
             key = jax.random.fold_in(base_key, (epoch - 1) * steps_per_epoch + idx)
             batch = shard_host_batch((images_u8, labels), mesh)
             state, m = train_jit(state, batch[0], batch[1], key)
+            buffer.append(idx, m)
             if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
-                bsz = cfg.batch_size
-                losses.update(float(m["loss"]), bsz)
-                top1.update(100.0 * float(m["top1"]) / bsz, bsz)
-                top5.update(100.0 * float(m["top5"]) / bsz, bsz)
+                fold_metrics()
                 bt.update(time.time() - end)
                 logging.info(
                     "Train: [%d][%d/%d]\tBT %.3f (%.3f)\tloss %.3f (%.3f)\t"
@@ -251,6 +263,7 @@ def run(cfg: config_lib.LinearConfig):
                     losses.val, losses.avg, top1.val, top1.avg,
                 )
             end = time.time()
+        fold_metrics()
         logging.info(
             "Train epoch %d, total time %.2f, accuracy:%.2f",
             epoch, time.time() - t1, top1.avg,

@@ -8,9 +8,10 @@ Perf notes vs the reference hot loop:
 - augmentation + forward + loss + update is ONE compiled program per step; the
   host only permutes uint8 indices (no worker pool, no PIL, no pinned-memory
   staging);
-- metrics are fetched every ``print_freq`` steps instead of every step, keeping
-  XLA's async dispatch pipeline full (the reference's per-iter ``loss.item()``
-  is a sync point, ``main_supcon.py:320``);
+- per-step metrics are buffered on device and fetched in one batched transfer
+  every ``print_freq`` steps, keeping XLA's async dispatch pipeline full (the
+  reference's per-iter ``loss.item()`` is a sync point, ``main_supcon.py:320``)
+  while still metering/TB-logging EVERY step at reference cadence;
 - checkpoint RESUME is supported (``--resume``), which the reference lacks.
 """
 
@@ -33,7 +34,7 @@ from simclr_pytorch_distributed_tpu.ops.augment import (
     two_crop_batch,
 )
 from simclr_pytorch_distributed_tpu.ops import pallas_loss
-from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
+from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter, MetricBuffer
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
     batch_sharding,
@@ -143,15 +144,41 @@ def make_fused_update(model, tx, schedule, step_cfg, aug_cfg, mesh, state_exampl
     )
 
 
+TB_ITER_SCALARS = (  # reference per-iter scalars, main_supcon.py:327-333
+    "norm_mean", "norm_var", "record_norm_mean", "loss_sec", "loss_l2reg",
+)
+
+
 def train_one_epoch(
     epoch, loader, update_fn, state, mesh, base_key, cfg, tb, steps_per_epoch,
     tracer=None,
 ):
-    """One epoch (reference train(), main_supcon.py:242-351)."""
+    """One epoch (reference train(), main_supcon.py:242-351).
+
+    Metric handling: every step's device metrics are BUFFERED (no fetch, so
+    dispatch stays async) and flushed in one batched D2H transfer at each
+    ``print_freq`` boundary. That keeps the reference's observability
+    semantics — ``info/*`` TB scalars every iteration (main_supcon.py:327-333)
+    and a loss meter averaging ALL steps (main_supcon.py:320) — without the
+    reference's per-iter ``.item()`` sync point.
+    """
     batch_time, data_time, losses = AverageMeter(), AverageMeter(), AverageMeter()
     end = time.time()
-    pending = None  # (idx, metrics) fetched lazily to keep dispatch async
+    buffer = MetricBuffer()
+    last_host = {}  # most recently fetched metrics, as python floats
     bsz = cfg.batch_size
+
+    def flush():
+        """Fetch all buffered step metrics in one transfer; meter + TB them."""
+        nonlocal last_host
+        for (idx_f, gstep_f), m in buffer.flush():
+            check_finite_loss(m["loss"], gstep_f, cfg.nan_guard)
+            losses.update(m["loss"], bsz)
+            if is_main_process() and tb is not None:
+                it = epoch * steps_per_epoch + idx_f
+                for name in TB_ITER_SCALARS:
+                    tb.log_value(f"info/{name}", m[name], it)
+            last_host = m
 
     for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
         data_time.update(time.time() - end)
@@ -159,37 +186,25 @@ def train_one_epoch(
         key = jax.random.fold_in(base_key, global_step)
         batch = shard_host_batch((images_u8, labels), mesh)
         state, metrics = update_fn(state, batch[0], batch[1], key)
-        pending = (idx, global_step, metrics)
+        buffer.append((idx, global_step), metrics)
         if tracer is not None:
             tracer.step(global_step)
 
+        batch_time.update(time.time() - end)
         if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
-            idx_f, gstep_f, m = pending
-            m = {k: float(v) for k, v in m.items()}  # device sync point
-            check_finite_loss(m["loss"], gstep_f, cfg.nan_guard)
-            losses.update(m["loss"], bsz)
-            if is_main_process() and tb is not None:
-                # per-iter scalars (reference main_supcon.py:327-333)
-                it = epoch * steps_per_epoch + idx_f
-                tb.log_value("info/norm_mean", m["norm_mean"], it)
-                tb.log_value("info/norm_var", m["norm_var"], it)
-                tb.log_value("info/record_norm_mean", m["record_norm_mean"], it)
-                tb.log_value("info/loss_sec", m["loss_sec"], it)
-                tb.log_value("info/loss_l2reg", m["loss_l2reg"], it)
-            batch_time.update(time.time() - end)
+            flush()
             logging.info(
                 "Train: [%d][%d/%d]\tBT %.3f (%.3f)\tDT %.3f (%.3f)\t"
                 "loss %.3f (%.3f)\tnorm_mean %.3f (record: %.3f) var %.3f",
                 epoch, idx + 1, steps_per_epoch, batch_time.val, batch_time.avg,
                 data_time.val, data_time.avg, losses.val, losses.avg,
-                m["norm_mean"], m["record_norm_mean"], m["norm_var"],
+                last_host["norm_mean"], last_host["record_norm_mean"],
+                last_host["norm_var"],
             )
-        else:
-            batch_time.update(time.time() - end)
         end = time.time()
 
-    last_metrics = {k: float(v) for k, v in pending[2].items()} if pending else {}
-    return state, losses.avg if losses.count else last_metrics.get("loss", 0.0), last_metrics
+    flush()
+    return state, losses.avg if losses.count else last_host.get("loss", 0.0), last_host
 
 
 def enable_compile_cache(compile_cache: str, workdir: str) -> None:
@@ -254,49 +269,55 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         enabled=is_main_process(),
     )
 
-    for epoch in range(start_epoch, cfg.epochs + 1):
-        t1 = time.time()
-        # The update donates the incoming state's buffers, so the pre-epoch
-        # `state` object is DELETED after the first step — an un-donated
-        # on-device copy (one HBM->HBM copy per epoch) is what the crash
-        # handler can still save.
-        backup = jax.tree.map(jnp.copy, state) if cfg.nan_guard else None
-        try:
-            state, loss_avg, metrics = train_one_epoch(
-                epoch, loader, update_fn, state, mesh, base_key, cfg, tb,
-                steps_per_epoch, tracer=tracer,
-            )
-        except NonFiniteLossError:
-            # emergency save of the last epoch-boundary state so --resume can
-            # restart after the root cause is addressed (failure detection,
-            # SURVEY.md §5 — absent upstream)
+    try:
+        for epoch in range(start_epoch, cfg.epochs + 1):
+            t1 = time.time()
+            # The update donates the incoming state's buffers, so the pre-epoch
+            # `state` object is DELETED after the first step — an un-donated
+            # on-device copy (one HBM->HBM copy per epoch) is what the crash
+            # handler can still save.
+            backup = jax.tree.map(jnp.copy, state) if cfg.nan_guard else None
+            try:
+                state, loss_avg, metrics = train_one_epoch(
+                    epoch, loader, update_fn, state, mesh, base_key, cfg, tb,
+                    steps_per_epoch, tracer=tracer,
+                )
+            except NonFiniteLossError:
+                # emergency save of the last epoch-boundary state so --resume
+                # can restart after the root cause is addressed (failure
+                # detection, SURVEY.md §5 — absent upstream)
+                if is_main_process():
+                    save_checkpoint(
+                        cfg.save_folder, f"crash_epoch_{epoch}", backup,
+                        config=config_lib.config_dict(cfg), epoch=epoch - 1,
+                    )
+                    logging.error("non-finite loss: saved crash_epoch_%d", epoch)
+                raise
+            t2 = time.time()
+            logging.info("epoch %d, total time %.2f", epoch, t2 - t1)
             if is_main_process():
-                save_checkpoint(
-                    cfg.save_folder, f"crash_epoch_{epoch}", backup,
-                    config=config_lib.config_dict(cfg), epoch=epoch - 1,
-                )
-                logging.error("non-finite loss: saved crash_epoch_%d", epoch)
-            raise
-        t2 = time.time()
-        logging.info("epoch %d, total time %.2f", epoch, t2 - t1)
+                tb.log_value("loss", loss_avg, epoch)
+                tb.log_value("learning_rate", float(schedule((epoch - 1) * steps_per_epoch)), epoch)
+                if epoch % cfg.save_freq == 0:
+                    # async write: D2H serialization is synchronous (safe with
+                    # buffer donation), the disk write overlaps the next epochs
+                    save_checkpoint(
+                        cfg.save_folder, f"ckpt_epoch_{epoch}", state,
+                        config=config_lib.config_dict(cfg), epoch=epoch, block=False,
+                    )
         if is_main_process():
-            tb.log_value("loss", loss_avg, epoch)
-            tb.log_value("learning_rate", float(schedule((epoch - 1) * steps_per_epoch)), epoch)
-            if epoch % cfg.save_freq == 0:
-                # async write: D2H serialization is synchronous (safe with
-                # buffer donation), the disk write overlaps the next epochs
-                save_checkpoint(
-                    cfg.save_folder, f"ckpt_epoch_{epoch}", state,
-                    config=config_lib.config_dict(cfg), epoch=epoch, block=False,
-                )
-    if is_main_process():
+            wait_for_saves()
+            save_checkpoint(
+                cfg.save_folder, "last", state,
+                config=config_lib.config_dict(cfg), epoch=cfg.epochs,
+            )
+    finally:
+        # On failure too: stop/flush an active profiler trace (it is most
+        # valuable exactly when the epoch loop died) and drain in-flight
+        # async checkpoint writes so finished payloads get their meta stamp.
+        tracer.close()
+        tb.close()
         wait_for_saves()
-        save_checkpoint(
-            cfg.save_folder, "last", state,
-            config=config_lib.config_dict(cfg), epoch=cfg.epochs,
-        )
-    tracer.close()
-    tb.close()
     return state
 
 
