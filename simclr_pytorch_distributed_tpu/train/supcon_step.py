@@ -114,6 +114,14 @@ def make_train_step(
     """
     if cfg.loss_impl == "ring" and mesh is None:
         raise ValueError("loss_impl='ring' needs the mesh passed to make_train_step")
+    if cfg.loss_impl == "fused" and mesh is not None and mesh.size > 1:
+        # the pallas_call has no partitioning rule: GSPMD would all-gather the
+        # features and run the kernel fully replicated on every device,
+        # silently losing the scaling the 'auto' heuristic avoids
+        raise ValueError(
+            "loss_impl='fused' is single-device only; on a multi-device mesh "
+            "use 'dense' (GSPMD-partitioned) or 'ring'"
+        )
 
     def loss_fn(params, state: TrainState, images, labels):
         feats, new_batch_stats = two_view_forward(

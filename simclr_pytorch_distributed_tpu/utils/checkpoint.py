@@ -33,33 +33,47 @@ import orbax.checkpoint as ocp
 META_FILE = "meta.json"
 
 # checkpointers whose background write is still in flight (block=False saves)
-_PENDING: List[ocp.StandardCheckpointer] = []
+# async saves in flight: each entry is one logical checkpoint —
+# (its checkpointers, its directory, its meta). meta.json is the "checkpoint
+# complete" marker consumers look at, so it is stamped only after THAT
+# checkpoint's own payload writes commit (a crash mid-write must never leave a
+# complete-looking but unloadable checkpoint).
+_PENDING: List[Tuple[List[ocp.StandardCheckpointer], str, dict]] = []
 
 
 def _abstract(tree):
     return jax.tree.map(ocp.utils.to_shape_dtype_struct, tree)
 
 
-def _save_tree(path: str, tree, block: bool = True) -> None:
+def _save_tree(path: str, tree, block: bool = True):
     """Orbax save. The D2H serialization is always synchronous (so donated
     device buffers are safe to reuse immediately), but with ``block=False`` the
-    disk write continues in a background thread — call ``wait_for_saves()``
-    before reading the checkpoint back or exiting."""
+    disk write continues in a background thread (the returned checkpointer is
+    still open) — call ``wait_for_saves()`` before reading the checkpoint back
+    or exiting."""
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, tree, force=True)
     if block:
         ckptr.wait_until_finished()
         ckptr.close()
-    else:
-        _PENDING.append(ckptr)
+        return None
+    return ckptr
+
+
+def _write_meta(path: str, meta: dict) -> None:
+    with open(os.path.join(path, META_FILE), "w") as f:
+        json.dump(meta, f, indent=1, default=str)
 
 
 def wait_for_saves() -> None:
-    """Drain all in-flight background checkpoint writes."""
+    """Drain all in-flight background checkpoint writes; each checkpoint's
+    meta.json marker is stamped as soon as ITS payloads commit."""
     while _PENDING:
-        c = _PENDING.pop()
-        c.wait_until_finished()
-        c.close()
+        ckptrs, path, meta = _PENDING.pop(0)
+        for c in ckptrs:
+            c.wait_until_finished()
+            c.close()
+        _write_meta(path, meta)
 
 
 def _restore_tree(path: str, abstract_tree):
@@ -84,12 +98,12 @@ def save_checkpoint(
         # (a save_freq of epochs ago) has long finished, so this is ~free
         wait_for_saves()
     path = os.path.abspath(os.path.join(save_folder, name))
-    _save_tree(
+    c1 = _save_tree(
         os.path.join(path, "model"),
         {"params": state.params, "batch_stats": state.batch_stats},
         block=block,
     )
-    _save_tree(
+    c2 = _save_tree(
         os.path.join(path, "train"),
         {
             "opt_state": state.opt_state,
@@ -99,8 +113,10 @@ def save_checkpoint(
         block=block,
     )
     meta = {"epoch": epoch, "config": config or {}}
-    with open(os.path.join(path, META_FILE), "w") as f:
-        json.dump(meta, f, indent=1, default=str)
+    if block:
+        _write_meta(path, meta)
+    else:
+        _PENDING.append(([c1, c2], path, meta))
     return path
 
 
@@ -127,10 +143,18 @@ def restore_checkpoint(path: str, abstract_state) -> Tuple[Any, dict]:
         record_norm_mean=train["record_norm_mean"],
     )
     meta_path = os.path.join(path, META_FILE)
-    meta = {}
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
+    if not os.path.exists(meta_path):
+        # meta.json is stamped only after the payload writes commit; its
+        # absence means the save was interrupted. Resuming anyway would
+        # silently restart at epoch 1 (wrong LR-schedule position) on top of
+        # trained weights — fail loudly instead.
+        raise RuntimeError(
+            f"{path} has no {META_FILE}: the checkpoint write was interrupted "
+            f"before completion. Resume from an earlier checkpoint (e.g. the "
+            f"previous ckpt_epoch_N or 'last')."
+        )
+    with open(meta_path) as f:
+        meta = json.load(f)
     return state, meta
 
 

@@ -60,13 +60,34 @@ def test_model_only_warm_start(tmp_path):
 
 
 def test_async_save_roundtrip(tmp_path):
-    """block=False saves complete after wait_for_saves() and restore exactly."""
+    """block=False saves complete after wait_for_saves() and restore exactly.
+
+    meta.json (the completeness marker) must NOT exist until the payload
+    writes commit at wait_for_saves()."""
+    import os
+
     from simclr_pytorch_distributed_tpu.utils.checkpoint import wait_for_saves
 
     _, _, state = small_state()
     save_checkpoint(str(tmp_path), "async_ck", state, epoch=3, block=False)
+    assert not os.path.exists(tmp_path / "async_ck" / "meta.json")
     wait_for_saves()
+    assert os.path.exists(tmp_path / "async_ck" / "meta.json")
     restored, meta = restore_checkpoint(str(tmp_path / "async_ck"), state)
     assert meta["epoch"] == 3
     for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_interrupted_checkpoint_fails_loudly(tmp_path):
+    """A checkpoint whose meta.json never got stamped (crash mid-save) must
+    refuse to resume rather than silently restarting at epoch 1."""
+    import os
+
+    import pytest
+
+    _, _, state = small_state()
+    path = save_checkpoint(str(tmp_path), "ck", state, epoch=5)
+    os.remove(os.path.join(path, "meta.json"))
+    with pytest.raises(RuntimeError, match="interrupted"):
+        restore_checkpoint(path, state)
