@@ -10,11 +10,22 @@ reference's per-epoch PIL re-decode in 8 DataLoader workers.
 ``store_size`` defaults to 2x the crop size so the device-side crop keeps the
 scale diversity of cropping near-original resolution, while the host array
 stays bounded (N * store_size^2 * 3 bytes).
+
+Scale: small trees (CIFAR-scale, the reference's actual usage) decode into an
+in-RAM array. Trees whose decoded size exceeds ``mmap_threshold_bytes`` decode
+ONCE into an on-disk ``.npy`` memmap cache and are returned memory-mapped, so
+host RSS stays bounded by the (reclaimable) page cache instead of anonymous
+memory — an ImageNet-scale tree no longer OOMs the host. The cache is keyed by
+a manifest hash (file paths, sizes, mtimes, store resolution) and reused across
+runs.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import tempfile
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -22,6 +33,9 @@ import numpy as np
 from simclr_pytorch_distributed_tpu.data.cifar import NumpyDataset
 
 IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp", ".ppm")
+
+# decoded trees larger than this go through the on-disk memmap cache
+DEFAULT_MMAP_THRESHOLD = 1 << 30  # 1 GiB
 
 
 def find_classes(root: str) -> List[str]:
@@ -34,10 +48,48 @@ def find_classes(root: str) -> List[str]:
     return classes
 
 
+def _scan_tree(root: str, classes: List[str]) -> Tuple[List[str], List[int]]:
+    """All image paths + class indices, in deterministic sorted order."""
+    paths, labels = [], []
+    for cls_idx, cls in enumerate(classes):
+        cls_dir = os.path.join(root, cls)
+        for dirpath, _, filenames in sorted(os.walk(cls_dir)):
+            for fname in sorted(filenames):
+                if fname.lower().endswith(IMG_EXTENSIONS):
+                    paths.append(os.path.join(dirpath, fname))
+                    labels.append(cls_idx)
+    if not paths:
+        raise FileNotFoundError(f"no images with {IMG_EXTENSIONS} under {root}")
+    return paths, labels
+
+
+def _manifest_key(paths: List[str], store: int) -> str:
+    """Content key for the decode cache: path list + (size, mtime) + store res."""
+    h = hashlib.sha256()
+    h.update(str(store).encode())
+    for p in paths:
+        st = os.stat(p)
+        h.update(p.encode())
+        h.update(f"{st.st_size}:{int(st.st_mtime)}".encode())
+    return h.hexdigest()[:32]
+
+
+def _decode_one(path: str, store: int) -> np.ndarray:
+    from PIL import Image
+
+    with Image.open(path) as im:
+        return np.asarray(
+            im.convert("RGB").resize((store, store), Image.BILINEAR),
+            dtype=np.uint8,
+        )
+
+
 def load_image_folder(
     root: str,
     size: int = 32,
     store_size: Optional[int] = None,
+    cache_dir: Optional[str] = None,
+    mmap_threshold_bytes: int = DEFAULT_MMAP_THRESHOLD,
 ) -> Tuple[NumpyDataset, List[str]]:
     """Decode a class-per-subdir image tree into uint8 [N, S, S, 3] + labels.
 
@@ -45,29 +97,51 @@ def load_image_folder(
       root: dataset root (each subdir is one class).
       size: the training crop size (``--size``).
       store_size: host-side storage resolution; default ``2 * size``.
+      cache_dir: where the memmap decode cache lives for large trees
+        (default: ``$TMPDIR/sptpu_folder_cache``).
+      mmap_threshold_bytes: decoded sizes above this are decoded into an
+        on-disk memmap instead of RAM.
 
     Returns:
-      ({'images': u8 [N,S,S,3], 'labels': i32 [N]}, class_names)
+      ({'images': u8 [N,S,S,3] (ndarray or read-only memmap), 'labels':
+      i32 [N]}, class_names)
     """
-    from PIL import Image
-
     s = store_size or 2 * size
     classes = find_classes(root)
-    images, labels = [], []
-    for cls_idx, cls in enumerate(classes):
-        cls_dir = os.path.join(root, cls)
-        for dirpath, _, filenames in sorted(os.walk(cls_dir)):
-            for fname in sorted(filenames):
-                if not fname.lower().endswith(IMG_EXTENSIONS):
-                    continue
-                with Image.open(os.path.join(dirpath, fname)) as im:
-                    im = im.convert("RGB").resize((s, s), Image.BILINEAR)
-                    images.append(np.asarray(im, dtype=np.uint8))
-                labels.append(cls_idx)
-    if not images:
-        raise FileNotFoundError(f"no images with {IMG_EXTENSIONS} under {root}")
-    data = {
-        "images": np.stack(images),
-        "labels": np.asarray(labels, np.int32),
-    }
-    return data, classes
+    paths, labels = _scan_tree(root, classes)
+    labels_arr = np.asarray(labels, np.int32)
+    n = len(paths)
+    decoded_bytes = n * s * s * 3
+
+    if decoded_bytes <= mmap_threshold_bytes:
+        images = np.stack([_decode_one(p, s) for p in paths])
+        return {"images": images, "labels": labels_arr}, classes
+
+    # Large tree: decode once into an on-disk .npy memmap, then map read-only.
+    cache_root = cache_dir or os.path.join(
+        tempfile.gettempdir(), "sptpu_folder_cache"
+    )
+    os.makedirs(cache_root, exist_ok=True)
+    key = _manifest_key(paths, s)
+    arr_path = os.path.join(cache_root, f"{key}.npy")
+    meta_path = os.path.join(cache_root, f"{key}.json")
+
+    if not (os.path.exists(arr_path) and os.path.exists(meta_path)):
+        # unique per-process temp name: concurrent decoders of the same tree
+        # (e.g. pretrain + probe sharing --data_folder) race benignly — each
+        # writes its own file and os.replace commits whole files atomically
+        fd, tmp_path = tempfile.mkstemp(suffix=".npy.tmp", dir=cache_root)
+        os.close(fd)
+        out = np.lib.format.open_memmap(
+            tmp_path, mode="w+", dtype=np.uint8, shape=(n, s, s, 3)
+        )
+        for i, p in enumerate(paths):
+            out[i] = _decode_one(p, s)
+        out.flush()
+        del out
+        os.replace(tmp_path, arr_path)  # atomic: no half-decoded cache
+        with open(meta_path, "w") as f:
+            json.dump({"n": n, "store": s, "root": os.path.abspath(root)}, f)
+
+    images = np.load(arr_path, mmap_mode="r")
+    return {"images": images, "labels": labels_arr}, classes

@@ -55,3 +55,41 @@ def test_empty_root_raises(tmp_path):
     (tmp_path / "cls_a").mkdir()
     with pytest.raises(FileNotFoundError):
         load_image_folder(str(tmp_path))
+
+
+def test_large_tree_uses_memmap_cache(image_tree, tmp_path):
+    """Above the threshold the decode goes through an on-disk memmap (bounded
+    host RSS: pages are file-backed and reclaimable, not anonymous memory),
+    and a second load reuses the cache without re-decoding."""
+    root, _ = image_tree
+    cache = tmp_path / "cache"
+    data, _ = load_image_folder(
+        str(root), size=16, cache_dir=str(cache), mmap_threshold_bytes=1
+    )
+    assert isinstance(data["images"], np.memmap)
+    assert data["images"].shape == (5, 32, 32, 3)
+    # identical content to the in-RAM path
+    ram, _ = load_image_folder(str(root), size=16)
+    np.testing.assert_array_equal(np.asarray(data["images"]), ram["images"])
+
+    # second load: cache hit (the .npy's mtime must not change)
+    npys = list(cache.glob("*.npy"))
+    assert len(npys) == 1
+    mtime = npys[0].stat().st_mtime_ns
+    data2, _ = load_image_folder(
+        str(root), size=16, cache_dir=str(cache), mmap_threshold_bytes=1
+    )
+    assert npys[0].stat().st_mtime_ns == mtime
+    np.testing.assert_array_equal(np.asarray(data2["images"]), ram["images"])
+
+    # touching a source image invalidates the manifest key -> fresh cache entry
+    some_img = next((root / "cats").glob("*.png"))
+    arr = np.zeros((48, 64, 3), np.uint8)
+    Image.fromarray(arr).save(some_img)
+    import os as _os
+    _os.utime(some_img, (0, 0))  # force a distinct mtime second
+    data3, _ = load_image_folder(
+        str(root), size=16, cache_dir=str(cache), mmap_threshold_bytes=1
+    )
+    assert len(list(cache.glob("*.npy"))) == 2
+    assert np.asarray(data3["images"]).sum() != np.asarray(data2["images"]).sum()
