@@ -138,6 +138,13 @@ class ResNet(nn.Module):
         return x.astype(jnp.float32)
 
 
+def resnet10(**kwargs) -> ResNet:
+    """One BasicBlock per stage — NOT in the reference model_dict
+    (resnet_big.py:137-142); an extension for fast smoke tests and small
+    experiments where resnet18's compile time dominates."""
+    return ResNet(block_cls=BasicBlock, stage_sizes=(1, 1, 1, 1), **kwargs)
+
+
 def resnet18(**kwargs) -> ResNet:
     return ResNet(block_cls=BasicBlock, stage_sizes=(2, 2, 2, 2), **kwargs)
 
@@ -156,6 +163,7 @@ def resnet101(**kwargs) -> ResNet:
 
 # name -> (constructor, feature dim); reference model_dict resnet_big.py:137-142.
 MODEL_DICT: dict[str, Tuple[Callable[..., ResNet], int]] = {
+    "resnet10": (resnet10, 512),  # test/smoke extension, not in the reference
     "resnet18": (resnet18, 512),
     "resnet34": (resnet34, 512),
     "resnet50": (resnet50, 2048),

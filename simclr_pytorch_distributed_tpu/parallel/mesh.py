@@ -18,7 +18,6 @@ runtime is a single SPMD program:
 from __future__ import annotations
 
 import logging
-import os
 from typing import Optional, Sequence
 
 import jax
@@ -64,11 +63,6 @@ def create_mesh(
     """Build a (data, model) mesh over all devices; model axis defaults to 1."""
     if devices is None:
         devices = jax.devices()
-        # test/debug hook: cap mesh size (GSPMD partitioning cost on the
-        # single-core CPU test host scales with partition count)
-        limit = int(os.environ.get("SPTPU_MAX_DEVICES", "0"))
-        if limit:
-            devices = devices[:limit]
     devices = list(devices)
     n = len(devices)
     if n % model_parallel != 0:

@@ -14,6 +14,8 @@ import numpy as np
 import optax
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: sharded-step programs on the 1-core CPU host
+
 from simclr_pytorch_distributed_tpu.models import SupConResNet
 from simclr_pytorch_distributed_tpu.ops.losses import supcon_loss
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule

@@ -9,6 +9,8 @@ a handful of steps — compile time dominates, so keep program count low.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: sharded-step programs on the 1-core CPU host
+
 from simclr_pytorch_distributed_tpu import config as config_lib
 from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib
 from simclr_pytorch_distributed_tpu.train import ce as ce_driver
@@ -20,20 +22,33 @@ SIZE = 16  # image side for all integration runs
 
 @pytest.fixture(autouse=True)
 def small_synthetic(monkeypatch):
+    import jax
+
+    from simclr_pytorch_distributed_tpu.parallel import mesh as mesh_lib
+
     orig = cifar_lib.synthetic_dataset
 
     def small(n=2048, num_classes=10, seed=0, size=32):
         return orig(n=320, num_classes=num_classes, seed=seed, size=SIZE)
 
     monkeypatch.setattr(cifar_lib, "synthetic_dataset", small)
-    # 2-device mesh: the GSPMD partitioner cost on the 1-core CPU host scales
-    # with partition count; 8-way sharding is covered by test_distributed.py
-    monkeypatch.setenv("SPTPU_MAX_DEVICES", "2")
+
+    # 1-device mesh: the GSPMD partitioner cost on the 1-core CPU host scales
+    # with partition count, and multi-way sharding semantics are covered by
+    # test_distributed.py — integration only needs the drivers end-to-end.
+    # The drivers import create_mesh by name, so patch their module bindings.
+    def limited_create_mesh(devices=None, **kw):
+        if devices is None:
+            devices = jax.devices()[:1]
+        return mesh_lib.create_mesh(devices=devices, **kw)
+
+    for driver in (supcon_driver, linear_driver, ce_driver):
+        monkeypatch.setattr(driver, "create_mesh", limited_create_mesh)
 
 
 def supcon_cfg(tmp_path, **over):
     base = dict(
-        model="resnet18", dataset="synthetic", batch_size=64, epochs=2,
+        model="resnet10", dataset="synthetic", batch_size=64, epochs=2,
         learning_rate=0.05, temp=0.5, cosine=True, syncBN=True,
         save_freq=2, print_freq=2, size=SIZE, workdir=str(tmp_path),
         seed=0, method="SimCLR",
@@ -50,7 +65,7 @@ def test_supcon_then_probe_end_to_end(tmp_path):
     assert int(state.step) == 2 * (280 // 64)
 
     lcfg = config_lib.LinearConfig(
-        model="resnet18", dataset="synthetic", batch_size=64, epochs=2,
+        model="resnet10", dataset="synthetic", batch_size=64, epochs=2,
         learning_rate=0.5, size=SIZE, val_batch_size=40, workdir=str(tmp_path),
         ckpt=f"{cfg.save_folder}/last", print_freq=2,
     )
@@ -75,7 +90,7 @@ def test_ce_driver_end_to_end(tmp_path):
     # fusion) flipped the trajectory between ~8% and ~20% val top-1. At lr 0.1
     # / 10 epochs the margin is wide: 60-82% across seeds.
     cfg = config_lib.LinearConfig(
-        model="resnet18", dataset="synthetic", batch_size=64, epochs=10,
+        model="resnet10", dataset="synthetic", batch_size=64, epochs=10,
         learning_rate=0.1, size=SIZE, val_batch_size=40, workdir=str(tmp_path),
         print_freq=100,
     )

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import torch
 
 from simclr_pytorch_distributed_tpu.models.norm import CrossReplicaBatchNorm
@@ -43,6 +44,7 @@ def test_eval_mode_uses_running_stats(rng):
     np.testing.assert_allclose(np.asarray(y), x / np.sqrt(1 + 1e-5), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_shard_map_sync_equals_full_batch(rng):
     """pmean-synced per-device BN == BN over the concatenated batch — the
     SyncBatchNorm semantic (reference main_supcon.py:223-224) mesh-natively."""
@@ -76,6 +78,7 @@ def test_shard_map_sync_equals_full_batch(rng):
     np.testing.assert_allclose(np.asarray(rv), np.asarray(mut_full["batch_stats"]["var"]), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_unsynced_bn_uses_local_stats(rng):
     """sync=False reproduces the reference's non---syncBN per-device BN."""
     from jax.sharding import Mesh, PartitionSpec as P

@@ -62,6 +62,7 @@ def test_ring_supcon_labels_matches_dense():
     np.testing.assert_allclose(float(ring), float(dense), rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_gradients_match_dense():
     B, V, D = 8, 2, 12
     f = jnp.asarray(normed(3, B, V, D))
