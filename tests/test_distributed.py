@@ -258,3 +258,29 @@ def test_tensor_parallel_step_matches_replicated():
     )
     for a, b in zip(jax.tree.leaves(ref_state.params), jax.tree.leaves(new_state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=2e-5)
+
+
+def test_tp_with_ring_loss_at_scale():
+    """VERDICT r1 #6: tensor-parallel (model=2) x ring loss together on a
+    bigger-than-tiny step — global batch 256 (32 rows/device over data=4),
+    resnet10 @ 16x16 — must match the replicated dense single-program step."""
+    model, tx, schedule, cfg, state, images, labels = tiny_setup(
+        batch=256, image=16, model_name="resnet10"
+    )
+    plain_step = make_train_step(model, tx, schedule, cfg)
+    ref_state, ref_metrics = jax.jit(plain_step)(state, images, labels)
+
+    mesh = create_mesh(model_parallel=2)
+    assert mesh.shape == {"data": 4, "model": 2}
+    ring_cfg = dataclasses.replace(cfg, loss_impl="ring")
+    step = make_sharded_train_step(
+        model, tx, schedule, ring_cfg, mesh, state_shape=state, donate=False
+    )
+    sh_images, sh_labels = shard_host_batch((images, labels), mesh)
+    new_state, metrics = step(state, sh_images, sh_labels)
+
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(ref_metrics["loss"]), rtol=2e-5
+    )
+    for a, b in zip(jax.tree.leaves(ref_state.params), jax.tree.leaves(new_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3)

@@ -91,3 +91,23 @@ def test_ring_four_views():
     np.testing.assert_allclose(
         float(ring), float(dense_loss(f, temperature=0.5)), rtol=2e-5
     )
+
+
+@pytest.mark.slow
+def test_ring_matches_dense_at_recipe_scale():
+    """VERDICT r1 #6: ring == dense at the ImageNet-recipe loss scale —
+    global batch 4096 (512 rows/device on the 8-way mesh), 8192x8192 logical
+    logits. Value AND gradient, fp32."""
+    B, V, D = 4096, 2, 128
+    f = jnp.asarray(normed(7, B, V, D))
+    rows = to_rows(f)
+
+    dense_val, dense_grad = jax.value_and_grad(
+        lambda r: dense_loss(r.reshape(V, B, D).transpose(1, 0, 2))
+    )(rows)
+    ring_val, ring_grad = jax.value_and_grad(lambda r: ring_on_mesh(r))(rows)
+
+    np.testing.assert_allclose(float(ring_val), float(dense_val), rtol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(ring_grad), np.asarray(dense_grad), atol=2e-6
+    )
