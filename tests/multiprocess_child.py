@@ -1,0 +1,87 @@
+"""Child worker for test_multiprocess.py: one REAL OS process of a
+multi-process data-parallel training step (the multi-host path of
+parallel/mesh.py + data/pipeline.py).
+
+Usage: python multiprocess_child.py <process_id> <num_processes> <port>
+
+With num_processes > 1 it joins a gloo-backed jax.distributed cluster (each
+process contributing its single CPU device) and prints the first training
+step's loss; with num_processes == 1 it computes the same GLOBAL step alone
+(two virtual CPU devices) as the reference value. The parent asserts all
+printed losses match.
+"""
+
+import os
+import sys
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+if nproc == 1:
+    # single-process reference: same 2-way partitioning, one process
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+if cache_dir:
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+if nproc > 1:
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+
+import jax.numpy as jnp
+import numpy as np
+
+from simclr_pytorch_distributed_tpu.data.pipeline import EpochLoader
+from simclr_pytorch_distributed_tpu.models import SupConResNet
+from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
+from simclr_pytorch_distributed_tpu.parallel.mesh import (
+    create_mesh,
+    shard_host_batch,
+)
+from simclr_pytorch_distributed_tpu.train.state import (
+    create_train_state,
+    make_optimizer,
+)
+from simclr_pytorch_distributed_tpu.train.supcon_step import (
+    SupConStepConfig,
+    make_sharded_train_step,
+)
+
+B, size = 8, 8
+model = SupConResNet(model_name="resnet10")
+schedule = make_lr_schedule(
+    learning_rate=0.05, epochs=2, steps_per_epoch=2, cosine=True
+)
+tx = make_optimizer(schedule, momentum=0.9, weight_decay=1e-4)
+state = create_train_state(model, tx, jax.random.key(0), jnp.zeros((2, size, size, 3)))
+cfg = SupConStepConfig(
+    method="SimCLR", temperature=0.5, epochs=2, steps_per_epoch=2, grad_div=2.0
+)
+mesh = create_mesh()
+assert mesh.size == 2, mesh
+step = make_sharded_train_step(
+    model, tx, schedule, cfg, mesh, state_shape=state, donate=False
+)
+
+# identical dataset on every process; EpochLoader slices this process's
+# contiguous block of each global batch (the DistributedSampler equivalent)
+rng = np.random.default_rng(0)
+images = rng.standard_normal((2 * B, 2, size, size, 3)).astype(np.float32)
+labels = rng.integers(0, 4, 2 * B).astype(np.int32)
+loader = EpochLoader(
+    images, labels, B, base_seed=0,
+    process_index=jax.process_index(), process_count=jax.process_count(),
+    prefetch=0,
+)
+imgs_local, labs_local = next(iter(loader.epoch(1)))
+batch = shard_host_batch((imgs_local, labs_local), mesh)
+new_state, metrics = step(state, batch[0], batch[1])
+print(f"LOSS {float(metrics['loss']):.8f}", flush=True)
