@@ -84,6 +84,9 @@ class SupConConfig:
     # 'ring' streams contrast blocks around the data axis with ppermute
     # (parallel/collectives.py) for large-global-batch memory scaling
     loss_impl: str = "auto"
+    # 'sgd' is the published recipe (util.py:79-84); 'lars' for the
+    # large-global-batch configs (SimCLR ImageNet bs=4096, BASELINE configs[4])
+    optimizer: str = "sgd"
     # jax.profiler trace capture (SURVEY.md §5 tracing row; reference has none)
     trace_dir: str = ""
     trace_start_step: int = 10
@@ -160,6 +163,9 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--workdir", type=str, default=d.workdir)
     p.add_argument("--loss_impl", type=str, default=d.loss_impl,
                    choices=["auto", "dense", "fused", "ring"])
+    p.add_argument("--optimizer", type=str, default=d.optimizer,
+                   choices=["sgd", "lars"],
+                   help="lars: layer-adaptive scaling for large global batches")
     p.add_argument("--trace_dir", type=str, default=d.trace_dir,
                    help="capture a jax.profiler trace into this dir")
     p.add_argument("--trace_start_step", type=int, default=d.trace_start_step)

@@ -35,7 +35,31 @@ def make_optimizer(
     learning_rate: Union[float, Callable],
     momentum: float = 0.9,
     weight_decay: float = 1e-4,
+    optimizer: str = "sgd",
 ) -> optax.GradientTransformation:
+    """``sgd`` reproduces the reference recipe (module docstring). ``lars``
+    (layer-wise adaptive rate scaling) is the standard choice for the
+    large-global-batch configs the reference never reached (SimCLR ImageNet
+    bs=4096, BASELINE.json configs[4]) — trust-ratio-scaled SGD+momentum with
+    the same weight-decay-everything semantics."""
+    if optimizer == "lars":
+        # Standard LARS recipe (SimCLR/LARS papers): biases and BN
+        # scale/bias (all 1-D tensors) are EXCLUDED from both weight decay
+        # and trust-ratio adaptation — otherwise zero-init offsets freeze
+        # near zero and BN scales train with a ~1000x smaller effective lr.
+        def kernels_only(params):
+            return jax.tree.map(lambda p: jnp.ndim(p) > 1, params)
+
+        return optax.lars(
+            learning_rate=learning_rate,
+            weight_decay=weight_decay,
+            weight_decay_mask=kernels_only,
+            trust_ratio_mask=kernels_only,
+            momentum=momentum,
+            nesterov=False,
+        )
+    if optimizer != "sgd":
+        raise ValueError(f"optimizer not supported: {optimizer}")
     parts = []
     if weight_decay:
         parts.append(optax.add_decayed_weights(weight_decay))

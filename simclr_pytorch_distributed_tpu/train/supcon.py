@@ -111,7 +111,10 @@ def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1
         lr_decay_rate=cfg.lr_decay_rate, lr_decay_epochs=cfg.lr_decay_epochs,
         warm=cfg.warm, warm_epochs=cfg.warm_epochs, warmup_from=cfg.warmup_from,
     )
-    tx = make_optimizer(schedule, momentum=cfg.momentum, weight_decay=cfg.weight_decay)
+    tx = make_optimizer(
+        schedule, momentum=cfg.momentum, weight_decay=cfg.weight_decay,
+        optimizer=cfg.optimizer,
+    )
     state = create_train_state(
         model, tx, jax.random.key(cfg.seed),
         jnp.zeros((2, cfg.size, cfg.size, 3), jnp.float32),
