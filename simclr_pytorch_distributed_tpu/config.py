@@ -133,7 +133,7 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--model", type=str, default=d.model)
     p.add_argument("--dataset", type=str, default=d.dataset,
-                   choices=["cifar10", "cifar100", "path", "synthetic", "synthetic_hard"])
+                   choices=["cifar10", "cifar100", "path", "synthetic", "synthetic_hard", "synthetic_hard32"])
     p.add_argument("--mean", type=str, default=None,
                    help="mean of dataset in path in form of str tuple")
     p.add_argument("--std", type=str, default=None)
@@ -274,7 +274,7 @@ def linear_parser(ce: bool = False) -> argparse.ArgumentParser:
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--model", type=str, default=d.model)
     p.add_argument("--dataset", type=str, default=d.dataset,
-                   choices=["cifar10", "cifar100", "synthetic", "synthetic_hard"])
+                   choices=["cifar10", "cifar100", "synthetic", "synthetic_hard", "synthetic_hard32"])
     _add_bool_flag(p, "cosine")
     _add_bool_flag(p, "warm")
     if not ce:
@@ -315,7 +315,8 @@ def finalize_linear(
         cfg.warmup_to = warmup_to_value(
             cfg.learning_rate, cfg.lr_decay_rate, cfg.warm_epochs, cfg.epochs, cfg.cosine
         )
-    cfg.n_cls = {"cifar10": 10, "cifar100": 100, "synthetic": 10, "synthetic_hard": 10}[cfg.dataset]
+    cfg.n_cls = {"cifar10": 10, "cifar100": 100, "synthetic": 10, "synthetic_hard": 10,
+                 "synthetic_hard32": 32}[cfg.dataset]
 
     now_time = datetime.datetime.now().strftime("%m%d_%H%M")
     run = prefix + now_time + "_"

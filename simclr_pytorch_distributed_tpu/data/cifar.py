@@ -167,6 +167,11 @@ def load_dataset(
     elif dataset == "synthetic_hard":
         train, test = synthetic_texture_dataset()
         return train, test, 10
+    elif dataset == "synthetic_hard32":
+        # 32 classes at 2.8-degree orientation spacing: a deliberately
+        # non-saturated version of synthetic_hard for regression ratcheting
+        train, test = synthetic_texture_dataset(num_classes=32)
+        return train, test, 32
     else:
         raise ValueError(f"dataset not supported: {dataset}")
 

@@ -37,11 +37,13 @@ def make_optimizer(
     weight_decay: float = 1e-4,
     optimizer: str = "sgd",
 ) -> optax.GradientTransformation:
-    """``sgd`` reproduces the reference recipe (module docstring). ``lars``
-    (layer-wise adaptive rate scaling) is the standard choice for the
-    large-global-batch configs the reference never reached (SimCLR ImageNet
-    bs=4096, BASELINE.json configs[4]) — trust-ratio-scaled SGD+momentum with
-    the same weight-decay-everything semantics."""
+    """``sgd`` reproduces the reference recipe (module docstring), including
+    its weight-decay-everything semantics. ``lars`` (layer-wise adaptive rate
+    scaling) is the standard choice for the large-global-batch configs the
+    reference never reached (SimCLR ImageNet bs=4096, BASELINE.json
+    configs[4]); unlike the sgd path it follows the LARS-paper convention of
+    applying BOTH weight decay and trust-ratio adaptation to kernels only
+    (1-D params — biases, BN scale/bias — get plain SGD+momentum)."""
     if optimizer == "lars":
         # Standard LARS recipe (SimCLR/LARS papers): biases and BN
         # scale/bias (all 1-D tensors) are EXCLUDED from both weight decay

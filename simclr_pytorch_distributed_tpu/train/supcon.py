@@ -56,6 +56,7 @@ from simclr_pytorch_distributed_tpu.train.supcon_step import (
 )
 from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     load_pretrained_variables,
+    resolve_resume_path,
     restore_checkpoint,
     save_checkpoint,
     wait_for_saves,
@@ -170,11 +171,20 @@ def train_one_epoch(
     buffer = MetricBuffer()
     last_host = {}  # most recently fetched metrics, as python floats
     bsz = cfg.batch_size
+    window_start = time.time()
 
     def flush():
-        """Fetch all buffered step metrics in one transfer; meter + TB them."""
-        nonlocal last_host
-        for (idx_f, gstep_f), m in buffer.flush():
+        """Fetch all buffered step metrics in one transfer; meter + TB them.
+
+        Batch time is metered per flush window: under async dispatch the
+        per-iteration wall time only measures dispatch (~0), so the real
+        per-step time is (window wall time, INCLUDING this flush's device
+        sync) / steps — same aggregate semantics as the reference's per-iter
+        meter (main_supcon.py:336-337), amortized over print_freq steps.
+        """
+        nonlocal last_host, window_start
+        fetched = buffer.flush()  # device sync happens here
+        for (idx_f, gstep_f), m in fetched:
             check_finite_loss(m["loss"], gstep_f, cfg.nan_guard)
             losses.update(m["loss"], bsz)
             if is_main_process() and tb is not None:
@@ -182,6 +192,10 @@ def train_one_epoch(
                 for name in TB_ITER_SCALARS:
                     tb.log_value(f"info/{name}", m[name], it)
             last_host = m
+        if fetched:
+            per_step = (time.time() - window_start) / len(fetched)
+            batch_time.update(per_step, n=len(fetched))
+        window_start = time.time()
 
     for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
         data_time.update(time.time() - end)
@@ -193,7 +207,6 @@ def train_one_epoch(
         if tracer is not None:
             tracer.step(global_step)
 
-        batch_time.update(time.time() - end)
         if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
             flush()
             logging.info(
@@ -259,9 +272,10 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         )
         logging.info("load model from %s ...", cfg.ckpt)
     if cfg.resume:
-        state, meta = restore_checkpoint(cfg.resume, state)
+        resume_path = resolve_resume_path(cfg.resume)
+        state, meta = restore_checkpoint(resume_path, state)
         start_epoch = int(meta.get("epoch", 0)) + 1
-        logging.info("resumed from %s at epoch %d", cfg.resume, start_epoch)
+        logging.info("resumed from %s at epoch %d", resume_path, start_epoch)
 
     aug_cfg = make_augment_config(cfg)
     update_fn = make_fused_update(model, tx, schedule, step_cfg, aug_cfg, mesh, state)

@@ -120,6 +120,43 @@ def save_checkpoint(
     return path
 
 
+def resolve_resume_path(path: str) -> str:
+    """Accepts either one checkpoint dir or a RUN dir; returns a checkpoint.
+
+    Passing a run folder (the timestamped directory holding ``ckpt_epoch_N``/
+    ``crash_epoch_N``/``last``) picks the COMPLETE checkpoint (meta.json
+    present) with the highest recorded epoch — so after a crash,
+    ``--resume <run_dir>`` does the right thing without the user inspecting
+    which save survived.
+    """
+    path = os.path.abspath(path)
+    if os.path.exists(os.path.join(path, META_FILE)):
+        return path
+    if os.path.isdir(os.path.join(path, "model")):
+        # it IS a checkpoint dir (payload present) whose completeness marker
+        # never got stamped — keep the interrupted-save diagnostic rather
+        # than misreporting "contains no checkpoint"
+        raise RuntimeError(
+            f"{path} has no {META_FILE}: the checkpoint write was interrupted "
+            f"before completion. Resume from an earlier checkpoint, or pass "
+            f"the run directory to pick the latest complete one."
+        )
+    candidates = []
+    for name in os.listdir(path) if os.path.isdir(path) else []:
+        meta_path = os.path.join(path, name, META_FILE)
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            epoch = meta.get("epoch")
+            if epoch is not None:
+                candidates.append((int(epoch), os.path.join(path, name)))
+    if not candidates:
+        raise FileNotFoundError(
+            f"{path} contains no complete checkpoint (no */{META_FILE})"
+        )
+    return max(candidates)[1]
+
+
 def restore_checkpoint(path: str, abstract_state) -> Tuple[Any, dict]:
     """Full-state resume. ``abstract_state`` is a freshly built TrainState with
     the right structure (its values are only used as shape/dtype targets)."""
@@ -160,8 +197,14 @@ def restore_checkpoint(path: str, abstract_state) -> Tuple[Any, dict]:
 
 def load_pretrained_variables(path: str, abstract_variables: dict) -> dict:
     """Model-variables-only load: pretrain warm-start (main_supcon.py:216-220)
-    and the probe's encoder restore (main_linear.py:125-142)."""
+    and the probe's encoder restore (main_linear.py:125-142). Accepts a run
+    directory too (resolved to its latest complete checkpoint), so ``--ckpt``
+    and ``--resume`` take the same kinds of paths. A dir that directly holds a
+    ``model`` payload is used as-is — meta.json completeness only gates FULL
+    resume, not model-only loads (e.g. hand-built encoder checkpoints)."""
     path = os.path.abspath(path)
+    if not os.path.isdir(os.path.join(path, "model")):
+        path = resolve_resume_path(path)
     return _restore_tree(
         os.path.join(path, "model"),
         _abstract({"params": abstract_variables["params"],

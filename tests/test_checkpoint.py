@@ -91,3 +91,70 @@ def test_restore_interrupted_checkpoint_fails_loudly(tmp_path):
     os.remove(os.path.join(path, "meta.json"))
     with pytest.raises(RuntimeError, match="interrupted"):
         restore_checkpoint(path, state)
+
+
+def test_resolve_resume_picks_latest_complete(tmp_path):
+    """--resume <run_dir> resolves to the highest-epoch COMPLETE checkpoint."""
+    import os
+
+    import pytest
+
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        resolve_resume_path,
+    )
+
+    _, _, state = small_state()
+    save_checkpoint(str(tmp_path), "ckpt_epoch_2", state, epoch=2)
+    p5 = save_checkpoint(str(tmp_path), "crash_epoch_5", state, epoch=5)
+    p9 = save_checkpoint(str(tmp_path), "ckpt_epoch_9", state, epoch=9)
+    # an interrupted save (no meta.json) must not win
+    os.remove(os.path.join(p9, "meta.json"))
+    assert resolve_resume_path(str(tmp_path)) == p5
+    # a direct checkpoint path passes through unchanged
+    assert resolve_resume_path(p5) == p5
+    with pytest.raises(FileNotFoundError):
+        resolve_resume_path(str(tmp_path / "empty_nothing_here"))
+
+
+def test_warm_start_accepts_run_dir_and_model_only(tmp_path):
+    """--ckpt takes a run dir (resolved to latest complete) or a bare
+    model-only payload dir (no meta.json needed for variables-only loads)."""
+    import jax
+    import numpy as np
+
+    _, _, state = small_state()
+    save_checkpoint(str(tmp_path), "ckpt_epoch_3", state, epoch=3)
+    abstract = {"params": state.params, "batch_stats": state.batch_stats}
+    via_run_dir = load_pretrained_variables(str(tmp_path), abstract)
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(via_run_dir["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import _save_tree
+
+    bare = tmp_path / "bare_encoder"
+    _save_tree(str(bare / "model"),
+               {"params": state.params, "batch_stats": state.batch_stats})
+    via_bare = load_pretrained_variables(str(bare), abstract)
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(via_bare["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resolve_resume_interrupted_checkpoint_diagnostic(tmp_path):
+    """Pointing --resume at an interrupted checkpoint dir (payload, no
+    meta.json) keeps the 'interrupted' diagnostic instead of claiming the
+    dir contains no checkpoint."""
+    import os
+
+    import pytest
+
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        resolve_resume_path,
+    )
+
+    _, _, state = small_state()
+    path = save_checkpoint(str(tmp_path), "ckpt_epoch_4", state, epoch=4)
+    os.remove(os.path.join(path, "meta.json"))
+    with pytest.raises(RuntimeError, match="interrupted"):
+        resolve_resume_path(path)
