@@ -45,7 +45,7 @@ class SupConConfig:
     momentum: float = 0.9
     # model / dataset (main_supcon.py:49-56)
     model: str = "resnet50"
-    dataset: str = "cifar10"  # {cifar10, cifar100, path, synthetic, synthetic_hard}
+    dataset: str = "cifar10"  # {cifar10, cifar100, path, synthetic, synthetic_hard, synthetic_hard32}
     mean: Optional[str] = None
     std: Optional[str] = None
     data_folder: Optional[str] = None
@@ -53,6 +53,9 @@ class SupConConfig:
     # 'path' datasets: host-side storage resolution (0 = 2*size); the device
     # RandomResizedCrop samples from this resolution (data/folder.py)
     store_size: int = 0
+    # 'path' datasets: decoded trees above this go through the on-disk memmap
+    # cache instead of RAM (data/folder.py; bounded host RSS for big trees)
+    mmap_threshold_mb: int = 1024
     # method (main_supcon.py:58-64)
     method: str = "SimCLR"  # {SupCon, SimCLR}
     temp: float = 0.5
@@ -141,6 +144,8 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--size", type=int, default=d.size)
     p.add_argument("--store_size", type=int, default=d.store_size,
                    help="path datasets: stored resolution (0 = 2*size)")
+    p.add_argument("--mmap_threshold_mb", type=int, default=d.mmap_threshold_mb,
+                   help="path datasets: decode to an on-disk memmap above this size")
     p.add_argument("--method", type=str, default=d.method, choices=["SupCon", "SimCLR"])
     p.add_argument("--temp", type=float, default=d.temp)
     _add_bool_flag(p, "cosine")
@@ -236,7 +241,7 @@ class LinearConfig:
     weight_decay: float = 0.0
     momentum: float = 0.9
     model: str = "resnet50"
-    dataset: str = "cifar10"  # {cifar10, cifar100, synthetic, synthetic_hard}
+    dataset: str = "cifar10"  # {cifar10, cifar100, synthetic, synthetic_hard, synthetic_hard32}
     cosine: bool = False
     warm: bool = False
     ckpt: str = ""

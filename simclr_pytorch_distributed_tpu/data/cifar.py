@@ -136,9 +136,10 @@ def load_dataset(
     allow_synthetic_fallback: bool = False,
     size: int = 32,
     store_size: int = 0,
+    mmap_threshold_mb: int = 1024,
 ) -> Tuple[NumpyDataset, NumpyDataset, int]:
     """Returns (train, test, num_classes). ``dataset`` in {cifar10, cifar100,
-    path, synthetic, synthetic_hard}; with ``allow_synthetic_fallback`` a missing on-disk
+    path, synthetic, synthetic_hard, synthetic_hard32}; with ``allow_synthetic_fallback`` a missing on-disk
     dataset degrades to synthetic data with a warning (benchmark environments).
     ``path`` reads an ImageFolder-style class-per-subdir tree (train split
     only, like the reference main_supcon.py:189-191); ``size`` sets its
@@ -149,7 +150,8 @@ def load_dataset(
         from simclr_pytorch_distributed_tpu.data.folder import load_image_folder
 
         train, classes = load_image_folder(
-            data_folder, size=size, store_size=store_size or None
+            data_folder, size=size, store_size=store_size or None,
+            mmap_threshold_bytes=mmap_threshold_mb << 20,
         )
         # no val split in the reference's path mode; empty test set
         empty = {

@@ -250,7 +250,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
     train_data, _, _ = load_dataset(
         cfg.dataset, cfg.data_folder,
         allow_synthetic_fallback=(cfg.dataset == "synthetic"), size=cfg.size,
-        store_size=cfg.store_size,
+        store_size=cfg.store_size, mmap_threshold_mb=cfg.mmap_threshold_mb,
     )
     loader = EpochLoader(
         train_data["images"], train_data["labels"], cfg.batch_size,

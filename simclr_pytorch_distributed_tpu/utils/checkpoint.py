@@ -32,7 +32,6 @@ import orbax.checkpoint as ocp
 
 META_FILE = "meta.json"
 
-# checkpointers whose background write is still in flight (block=False saves)
 # async saves in flight: each entry is one logical checkpoint —
 # (its checkpointers, its directory, its meta). meta.json is the "checkpoint
 # complete" marker consumers look at, so it is stamped only after THAT
@@ -61,8 +60,13 @@ def _save_tree(path: str, tree, block: bool = True):
 
 
 def _write_meta(path: str, meta: dict) -> None:
-    with open(os.path.join(path, META_FILE), "w") as f:
+    # atomic: meta.json is the completeness marker, so it must never exist
+    # half-written (a truncated marker would crash resume resolution)
+    target = os.path.join(path, META_FILE)
+    tmp = target + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(meta, f, indent=1, default=str)
+    os.replace(tmp, target)
 
 
 def wait_for_saves() -> None:
@@ -145,8 +149,11 @@ def resolve_resume_path(path: str) -> str:
     for name in os.listdir(path) if os.path.isdir(path) else []:
         meta_path = os.path.join(path, name, META_FILE)
         if os.path.exists(meta_path):
-            with open(meta_path) as f:
-                meta = json.load(f)
+            try:
+                with open(meta_path) as f:
+                    meta = json.load(f)
+            except ValueError:
+                continue  # corrupt marker: skip, fall back to older complete saves
             epoch = meta.get("epoch")
             if epoch is not None:
                 candidates.append((int(epoch), os.path.join(path, name)))
@@ -204,7 +211,22 @@ def load_pretrained_variables(path: str, abstract_variables: dict) -> dict:
     resume, not model-only loads (e.g. hand-built encoder checkpoints)."""
     path = os.path.abspath(path)
     if not os.path.isdir(os.path.join(path, "model")):
-        path = resolve_resume_path(path)
+        try:
+            path = resolve_resume_path(path)
+        except (FileNotFoundError, RuntimeError):
+            # model-only policy: a committed payload without its meta marker
+            # is still loadable here — prefer 'last', else newest ckpt dir
+            subs = [
+                os.path.join(path, n) for n in sorted(os.listdir(path))
+                if os.path.isdir(os.path.join(path, n, "model"))
+            ] if os.path.isdir(path) else []
+            last = os.path.join(path, "last")
+            if os.path.isdir(os.path.join(last, "model")):
+                path = last
+            elif subs:
+                path = max(subs, key=os.path.getmtime)
+            else:
+                raise
     return _restore_tree(
         os.path.join(path, "model"),
         _abstract({"params": abstract_variables["params"],
