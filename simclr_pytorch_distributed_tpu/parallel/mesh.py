@@ -41,6 +41,10 @@ def setup_distributed(
     arguments are normally inferred from the environment, so a bare
     ``setup_distributed()`` suffices.
     """
+    if jax.process_count() > 1 and coordinator_address is None:
+        # already initialized (e.g. by a launcher wrapper before calling the
+        # driver) — initialize() would raise; the runtime is ready as-is
+        return
     if coordinator_address is None and jax.process_count() == 1 and num_processes in (None, 1):
         return
     jax.distributed.initialize(
@@ -69,6 +73,22 @@ def create_mesh(
         raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
     dev_array = np.array(devices).reshape(n // model_parallel, model_parallel)
     return Mesh(dev_array, tuple(axis_names))
+
+
+def sync_processes(tag: str) -> None:
+    """Cross-process barrier before exit paths.
+
+    In a multi-host job, process 0 finishes slow end-of-run I/O (final orbax
+    save, meter drains) AFTER the other processes fall off the epoch loop; if
+    they exit immediately, the JAX coordination-service shutdown barrier times
+    out and every process dies with a spurious INTERNAL error. One explicit
+    sync keeps all processes alive until the slowest is done. No-op on a
+    single process.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
 
 
 def is_main_process() -> bool:

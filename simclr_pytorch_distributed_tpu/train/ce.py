@@ -38,6 +38,7 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
     replicated_sharding,
     setup_distributed,
     shard_host_batch,
+    sync_processes,
 )
 from simclr_pytorch_distributed_tpu.train.linear import run_validation, stats_for, topk_correct
 from simclr_pytorch_distributed_tpu.train.supcon import enable_compile_cache
@@ -201,7 +202,9 @@ def run(cfg: config_lib.LinearConfig):
             tb.log_value("ce/val_acc5", val["top5"], epoch)
         if val["top1"] > best_acc:
             best_acc, best_acc5 = val["top1"], val["top5"]
-        if is_main_process() and epoch % cfg.save_freq == 0:
+        if epoch % cfg.save_freq == 0:
+            # collective on all processes (orbax coordinates writers;
+            # meta.json stays process-0-gated inside save_checkpoint)
             save_checkpoint(
                 cfg.save_folder, f"ckpt_epoch_{epoch}",
                 # CEState quacks enough like TrainState for the saver
@@ -212,6 +215,7 @@ def run(cfg: config_lib.LinearConfig):
     wait_for_saves()
     logging.info("best accuracy: %.2f, accuracy5: %.2f", best_acc, best_acc5)
     tb.close()
+    sync_processes("ce_run_end")
     return best_acc, best_acc5
 
 

@@ -54,6 +54,7 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
     replicated_sharding,
     setup_distributed,
     shard_host_batch,
+    sync_processes,
 )
 from simclr_pytorch_distributed_tpu.train.state import make_optimizer
 from simclr_pytorch_distributed_tpu.train.supcon import enable_compile_cache
@@ -287,6 +288,7 @@ def run(cfg: config_lib.LinearConfig):
 
     logging.info("best accuracy: %.2f, accuracy5: %.2f", best_acc, best_acc5)
     tb.close()
+    sync_processes("linear_run_end")
     return best_acc, best_acc5
 
 

@@ -44,6 +44,7 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
     setup_distributed,
     shard_host_batch,
     state_sharding,
+    sync_processes,
 )
 from simclr_pytorch_distributed_tpu.train.state import (
     TrainState,
@@ -302,12 +303,15 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
             except NonFiniteLossError:
                 # emergency save of the last epoch-boundary state so --resume
                 # can restart after the root cause is addressed (failure
-                # detection, SURVEY.md §5 — absent upstream)
+                # detection, SURVEY.md §5 — absent upstream). NOTE: orbax
+                # multi-process saves are collective — EVERY process calls
+                # save_checkpoint (orbax coordinates who writes; meta.json is
+                # process-0-gated inside); only logging stays process-0.
+                save_checkpoint(
+                    cfg.save_folder, f"crash_epoch_{epoch}", backup,
+                    config=config_lib.config_dict(cfg), epoch=epoch - 1,
+                )
                 if is_main_process():
-                    save_checkpoint(
-                        cfg.save_folder, f"crash_epoch_{epoch}", backup,
-                        config=config_lib.config_dict(cfg), epoch=epoch - 1,
-                    )
                     logging.error("non-finite loss: saved crash_epoch_%d", epoch)
                 raise
             t2 = time.time()
@@ -315,19 +319,19 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
             if is_main_process():
                 tb.log_value("loss", loss_avg, epoch)
                 tb.log_value("learning_rate", float(schedule((epoch - 1) * steps_per_epoch)), epoch)
-                if epoch % cfg.save_freq == 0:
-                    # async write: D2H serialization is synchronous (safe with
-                    # buffer donation), the disk write overlaps the next epochs
-                    save_checkpoint(
-                        cfg.save_folder, f"ckpt_epoch_{epoch}", state,
-                        config=config_lib.config_dict(cfg), epoch=epoch, block=False,
-                    )
-        if is_main_process():
-            wait_for_saves()
-            save_checkpoint(
-                cfg.save_folder, "last", state,
-                config=config_lib.config_dict(cfg), epoch=cfg.epochs,
-            )
+            if epoch % cfg.save_freq == 0:
+                # collective on all processes (see crash handler note); async
+                # write: D2H serialization is synchronous (safe with buffer
+                # donation), the disk write overlaps the next epochs
+                save_checkpoint(
+                    cfg.save_folder, f"ckpt_epoch_{epoch}", state,
+                    config=config_lib.config_dict(cfg), epoch=epoch, block=False,
+                )
+        wait_for_saves()
+        save_checkpoint(
+            cfg.save_folder, "last", state,
+            config=config_lib.config_dict(cfg), epoch=cfg.epochs,
+        )
     finally:
         # On failure too: stop/flush an active profiler trace (it is most
         # valuable exactly when the epoch loop died) and drain in-flight
@@ -335,6 +339,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         tracer.close()
         tb.close()
         wait_for_saves()
+    sync_processes("supcon_run_end")
     return state
 
 

@@ -30,6 +30,8 @@ from typing import Any, List, Optional, Tuple
 import jax
 import orbax.checkpoint as ocp
 
+from simclr_pytorch_distributed_tpu.parallel.mesh import is_main_process
+
 META_FILE = "meta.json"
 
 # async saves in flight: each entry is one logical checkpoint —
@@ -60,6 +62,10 @@ def _save_tree(path: str, tree, block: bool = True):
 
 
 def _write_meta(path: str, meta: dict) -> None:
+    # process-0-gated: orbax payload saves are collective across processes,
+    # but the completeness marker has exactly one writer.
+    if not is_main_process():
+        return
     # atomic: meta.json is the completeness marker, so it must never exist
     # half-written (a truncated marker would crash resume resolution)
     target = os.path.join(path, META_FILE)

@@ -2,19 +2,24 @@
 multi-process data-parallel training step (the multi-host path of
 parallel/mesh.py + data/pipeline.py).
 
-Usage: python multiprocess_child.py <process_id> <num_processes> <port>
+Usage: python multiprocess_child.py <process_id> <num_processes> <port> [mode]
 
 With num_processes > 1 it joins a gloo-backed jax.distributed cluster (each
 process contributing its single CPU device) and prints the first training
 step's loss; with num_processes == 1 it computes the same GLOBAL step alone
 (two virtual CPU devices) as the reference value. The parent asserts all
 printed losses match.
+
+mode 'driver' runs the FULL pretrain driver (supcon.run) instead of one step:
+epoch loops, meters, process-0-gated checkpointing/logging — the closest this
+host can get to a real 2-host launch.
 """
 
 import os
 import sys
 
 pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+mode = sys.argv[4] if len(sys.argv) > 4 else "step"
 if nproc == 1:
     # single-process reference: same 2-way partitioning, one process
     os.environ["XLA_FLAGS"] = (
@@ -35,6 +40,32 @@ if nproc > 1:
         num_processes=nproc,
         process_id=pid,
     )
+
+if mode == "driver":
+    # full driver: tiny synthetic run through supcon.run; process 0 owns I/O
+    from simclr_pytorch_distributed_tpu import config as config_lib
+    from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib
+
+    _orig = cifar_lib.synthetic_dataset
+    cifar_lib.synthetic_dataset = (
+        lambda n=2048, num_classes=10, seed=0, size=32: _orig(
+            n=128, num_classes=num_classes, seed=seed, size=8
+        )
+    )
+    from simclr_pytorch_distributed_tpu.train import supcon as supcon_driver
+
+    workdir = sys.argv[5]
+    cfg = config_lib.SupConConfig(
+        model="resnet10", dataset="synthetic", batch_size=32, epochs=2,
+        learning_rate=0.05, temp=0.5, cosine=True, syncBN=True,
+        save_freq=2, print_freq=2, size=8, workdir=workdir, seed=0,
+        method="SimCLR", trial="mp",
+    )
+    cfg = config_lib.finalize_supcon(cfg)
+    state = supcon_driver.run(cfg)
+    print(f"DRIVER step={int(state.step)} save_folder={cfg.save_folder}",
+          flush=True)
+    sys.exit(0)
 
 import jax.numpy as jnp
 import numpy as np
