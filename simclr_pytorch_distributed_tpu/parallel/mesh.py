@@ -41,12 +41,14 @@ def setup_distributed(
     arguments are normally inferred from the environment, so a bare
     ``setup_distributed()`` suffices.
     """
-    if jax.process_count() > 1 and coordinator_address is None:
-        # already initialized (e.g. by a launcher wrapper before calling the
-        # driver) — initialize() would raise; the runtime is ready as-is
-        return
-    if coordinator_address is None and jax.process_count() == 1 and num_processes in (None, 1):
-        return
+    if coordinator_address is None:
+        # No explicit coordinator: either the runtime was already initialized
+        # by a launcher wrapper (process_count > 1 — initialize() would
+        # raise), or this is a plain single-host run (nothing to do). Only
+        # this branch may touch process_count(): the explicit-coordinator
+        # path below must reach initialize() before any backend init.
+        if jax.process_count() > 1 or num_processes in (None, 1):
+            return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -73,6 +75,28 @@ def create_mesh(
         raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
     dev_array = np.array(devices).reshape(n // model_parallel, model_parallel)
     return Mesh(dev_array, tuple(axis_names))
+
+
+def broadcast_from_main(s: str, max_len: int = 512) -> str:
+    """Every process adopts process 0's value of a small string.
+
+    Run/checkpoint folder names embed a minute-resolution wall-clock
+    timestamp derived independently on each process (config parity with the
+    reference); with collective orbax saves the folder must agree across
+    hosts, so clock skew across a minute boundary would corrupt checkpoints.
+    No-op on a single process.
+    """
+    if jax.process_count() == 1:
+        return s
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(max_len, np.uint8)
+    raw = s.encode()
+    if len(raw) > max_len:
+        raise ValueError(f"string too long to broadcast ({len(raw)} > {max_len})")
+    buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf)
+    return bytes(np.asarray(out)).rstrip(b"\x00").decode()
 
 
 def sync_processes(tag: str) -> None:

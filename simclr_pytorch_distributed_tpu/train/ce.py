@@ -33,6 +33,7 @@ from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter, MetricBuffe
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
     batch_sharding,
+    broadcast_from_main,
     create_mesh,
     is_main_process,
     replicated_sharding,
@@ -114,6 +115,8 @@ def make_ce_steps(model, tx, aug_cfg, mesh):
 
 def run(cfg: config_lib.LinearConfig):
     setup_distributed()
+    cfg.save_folder = broadcast_from_main(cfg.save_folder)
+    cfg.tb_folder = broadcast_from_main(cfg.tb_folder)
     enable_compile_cache(cfg.compile_cache, cfg.workdir)
     setup_logging(cfg.save_folder, is_main_process())
     mesh = create_mesh()

@@ -38,6 +38,7 @@ from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter, MetricBuffe
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
     batch_sharding,
+    broadcast_from_main,
     create_mesh,
     is_main_process,
     replicated_sharding,
@@ -243,6 +244,10 @@ def enable_compile_cache(compile_cache: str, workdir: str) -> None:
 
 def run(cfg: config_lib.SupConConfig) -> TrainState:
     setup_distributed()
+    # collective saves need every process writing into process 0's run folder
+    # (the timestamped name is derived per-process, mesh.broadcast_from_main)
+    cfg.save_folder = broadcast_from_main(cfg.save_folder)
+    cfg.tb_folder = broadcast_from_main(cfg.tb_folder)
     enable_compile_cache(cfg.compile_cache, cfg.workdir)
     setup_logging(cfg.save_folder, is_main_process())
     mesh = create_mesh(model_parallel=cfg.model_parallel)
