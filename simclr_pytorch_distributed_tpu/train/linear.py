@@ -49,6 +49,7 @@ from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter, MetricBuffe
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
     batch_sharding,
+    broadcast_from_main,
     create_mesh,
     is_main_process,
     replicated_sharding,
@@ -58,7 +59,10 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
 )
 from simclr_pytorch_distributed_tpu.train.state import make_optimizer
 from simclr_pytorch_distributed_tpu.train.supcon import enable_compile_cache
-from simclr_pytorch_distributed_tpu.utils.checkpoint import load_pretrained_variables
+from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+    load_pretrained_variables,
+    save_classifier,
+)
 from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
 
 
@@ -188,6 +192,10 @@ def run_validation(eval_jit, params, val_images, val_labels, batch_size, mesh):
 
 def run(cfg: config_lib.LinearConfig):
     setup_distributed()
+    # the collective classifier save needs every process writing into
+    # process 0's timestamped run folder (ce.py/supcon.py do the same)
+    cfg.save_folder = broadcast_from_main(cfg.save_folder)
+    cfg.tb_folder = broadcast_from_main(cfg.tb_folder)
     enable_compile_cache(cfg.compile_cache, cfg.workdir)
     setup_logging(cfg.save_folder, is_main_process())
     mesh = create_mesh()
@@ -233,6 +241,7 @@ def run(cfg: config_lib.LinearConfig):
     tb = TBLogger(cfg.tb_folder, enabled=is_main_process())
     base_key = jax.random.key(cfg.seed + 1)
     best_acc, best_acc5 = 0.0, 0.0
+    best_params = None
 
     for epoch in range(1, cfg.epochs + 1):
         t1 = time.time()
@@ -285,7 +294,13 @@ def run(cfg: config_lib.LinearConfig):
             tb.log_value("classifier/val_acc5", val["top5"], epoch)
         if val["top1"] > best_acc:
             best_acc, best_acc5 = val["top1"], val["top5"]
+            best_params = jax.device_get(state.params)
 
+    if best_params is not None:
+        # beyond parity: persist the best probe head (the reference only
+        # reports best_acc, main_linear.py:284-288); collective orbax save
+        path = save_classifier(cfg.save_folder, best_params, best_acc)
+        logging.info("saved best classifier to %s", path)
     logging.info("best accuracy: %.2f, accuracy5: %.2f", best_acc, best_acc5)
     tb.close()
     sync_processes("linear_run_end")

@@ -208,6 +208,24 @@ def restore_checkpoint(path: str, abstract_state) -> Tuple[Any, dict]:
     return state, meta
 
 
+def save_classifier(save_folder: str, params, best_acc: float) -> str:
+    """Persist the best probe classifier head (beyond parity: the reference
+    reports best_acc but never saves the trained classifier,
+    main_linear.py:284-288)."""
+    path = os.path.abspath(os.path.join(save_folder, "classifier_best"))
+    _save_tree(os.path.join(path, "model"), {"params": params})
+    _write_meta(path, {"best_acc": best_acc})
+    return path
+
+
+def load_classifier(path: str, abstract_params):
+    """Restore a classifier head saved by ``save_classifier``."""
+    path = os.path.abspath(path)
+    return _restore_tree(
+        os.path.join(path, "model"), _abstract({"params": abstract_params})
+    )["params"]
+
+
 def load_pretrained_variables(path: str, abstract_variables: dict) -> dict:
     """Model-variables-only load: pretrain warm-start (main_supcon.py:216-220)
     and the probe's encoder restore (main_linear.py:125-142). Accepts a run

@@ -158,3 +158,20 @@ def test_resolve_resume_interrupted_checkpoint_diagnostic(tmp_path):
     os.remove(os.path.join(path, "meta.json"))
     with pytest.raises(RuntimeError, match="interrupted"):
         resolve_resume_path(path)
+
+
+def test_save_load_classifier_roundtrip(tmp_path):
+    import os
+
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        load_classifier,
+        save_classifier,
+    )
+
+    params = {"head": {"kernel": np.arange(12.0, dtype=np.float32).reshape(3, 4),
+                       "bias": np.zeros(4, np.float32)}}
+    path = save_classifier(str(tmp_path), params, 87.5)
+    assert os.path.exists(os.path.join(path, "meta.json"))
+    restored = load_classifier(path, params)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
