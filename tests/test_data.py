@@ -110,3 +110,31 @@ def test_epoch_loader_validation_mode():
     assert len(batches) == 3
     np.testing.assert_array_equal(batches[0][1], [0, 1, 2, 3])
     np.testing.assert_array_equal(batches[2][1], [8, 9])  # ragged tail kept
+
+
+def test_synthetic_texture_dataset_contract():
+    """Deterministic, disjoint split, labels in range, uint8 HWC — and class
+    signal is NOT in the color channel means (ColorJitter robustness: unlike
+    `synthetic_dataset`'s color-mean classes, per-class mean colors coincide)."""
+    import numpy as np
+
+    from simclr_pytorch_distributed_tpu.data.cifar import (
+        synthetic_texture_dataset,
+    )
+
+    tr1, te1 = synthetic_texture_dataset(n=512, num_classes=10, seed=3)
+    tr2, te2 = synthetic_texture_dataset(n=512, num_classes=10, seed=3)
+    np.testing.assert_array_equal(tr1["images"], tr2["images"])
+    np.testing.assert_array_equal(te1["labels"], te2["labels"])
+    assert tr1["images"].dtype == np.uint8
+    assert tr1["images"].shape[1:] == (32, 32, 3)
+    assert len(tr1["labels"]) + len(te1["labels"]) == 512
+    assert 0 <= tr1["labels"].min() and tr1["labels"].max() <= 9
+
+    # per-class mean color is ~identical across classes (no color shortcut):
+    # spread of class means is far below the within-class pixel std
+    means = np.stack([
+        tr1["images"][tr1["labels"] == c].mean(axis=(0, 1, 2))
+        for c in range(10)
+    ])
+    assert means.std(axis=0).max() < 0.1 * tr1["images"].std()
