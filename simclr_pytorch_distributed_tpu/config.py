@@ -99,6 +99,9 @@ class SupConConfig:
     compile_cache: str = "auto"
     # abort + emergency-checkpoint on NaN/Inf loss (utils/guard.py)
     nan_guard: bool = True
+    # per-block activation rematerialization: trades recompute FLOPs for HBM
+    # so bigger per-chip batches fit (identical numerics; models/resnet.py)
+    remat: bool = False
     # derived (finalize_supcon)
     warm_epochs: int = 10
     warmup_from: float = 0.01
@@ -176,6 +179,7 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace_start_step", type=int, default=d.trace_start_step)
     p.add_argument("--trace_steps", type=int, default=d.trace_steps)
     p.add_argument("--compile_cache", type=str, default=d.compile_cache)
+    _add_bool_flag(p, "remat", help="remat residual blocks (HBM for recompute)")
     p.add_argument("--nan_guard", type=_parse_bool,
                    default=d.nan_guard, help="abort + checkpoint on NaN loss")
     return p

@@ -74,11 +74,13 @@ class SupConResNet(nn.Module):
     dtype: Any = jnp.float32
     axis_name: Optional[str] = None
     sync_bn: bool = True
+    remat: bool = False  # per-block activation remat (models/resnet.py)
 
     def setup(self):
         model_fn, dim_in = MODEL_DICT[self.model_name]
         self.encoder = model_fn(
-            dtype=self.dtype, axis_name=self.axis_name, sync_bn=self.sync_bn
+            dtype=self.dtype, axis_name=self.axis_name, sync_bn=self.sync_bn,
+            remat=self.remat,
         )
         self.proj_head = ProjectionHead(
             head=self.head, dim_in=dim_in, feat_dim=self.feat_dim, dtype=self.dtype

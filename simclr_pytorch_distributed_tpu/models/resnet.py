@@ -45,7 +45,8 @@ class BasicBlock(nn.Module):
     norm: Callable[..., nn.Module] = CrossReplicaBatchNorm
 
     @nn.compact
-    def __call__(self, x, *, train: bool):
+    def __call__(self, x, train: bool = True):  # train is
+        # positional-or-keyword so nn.remat can mark it static (argnum 2)
         norm = partial(self.norm, use_running_average=not train)
         conv = partial(
             nn.Conv, use_bias=False, kernel_init=conv_kernel_init, dtype=self.dtype,
@@ -76,7 +77,8 @@ class Bottleneck(nn.Module):
     norm: Callable[..., nn.Module] = CrossReplicaBatchNorm
 
     @nn.compact
-    def __call__(self, x, *, train: bool):
+    def __call__(self, x, train: bool = True):  # train is
+        # positional-or-keyword so nn.remat can mark it static (argnum 2)
         norm = partial(self.norm, use_running_average=not train)
         conv = partial(
             nn.Conv, use_bias=False, kernel_init=conv_kernel_init, dtype=self.dtype,
@@ -108,11 +110,19 @@ class ResNet(nn.Module):
     dtype: Any = jnp.float32
     axis_name: Optional[str] = None
     sync_bn: bool = True
+    # activation rematerialization per residual block: backward recomputes
+    # each block's activations instead of keeping them in HBM — the standard
+    # FLOPs-for-memory trade for bigger per-chip batches (identical numerics)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
         norm = partial(
             CrossReplicaBatchNorm, axis_name=self.axis_name, sync=self.sync_bn
+        )
+        block_cls = (
+            nn.remat(self.block_cls, static_argnums=(2,))
+            if self.remat else self.block_cls
         )
         x = x.astype(self.dtype)
         x = nn.Conv(
@@ -127,13 +137,13 @@ class ResNet(nn.Module):
             zip(self.stage_sizes, widths, strides)
         ):
             for block in range(n_blocks):
-                x = self.block_cls(
+                x = block_cls(
                     planes=width,
                     stride=stage_stride if block == 0 else 1,
                     dtype=self.dtype,
                     norm=norm,
                     name=f"layer{stage + 1}_block{block}",
-                )(x, train=train)
+                )(x, train)
         x = jnp.mean(x, axis=(1, 2))  # global average pool (AdaptiveAvgPool2d((1,1)))
         return x.astype(jnp.float32)
 

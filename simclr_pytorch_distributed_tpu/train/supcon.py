@@ -106,7 +106,7 @@ def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1
     dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
     model = SupConResNet(
         model_name=cfg.model, head=cfg.head, feat_dim=cfg.feat_dim,
-        dtype=dtype, sync_bn=cfg.syncBN,
+        dtype=dtype, sync_bn=cfg.syncBN, remat=cfg.remat,
     )
     schedule = make_lr_schedule(
         learning_rate=cfg.learning_rate, epochs=cfg.epochs,

@@ -122,3 +122,36 @@ def test_linear_init_statistics():
     bound = 1.0 / np.sqrt(2048)
     assert k.min() >= -bound and k.max() <= bound
     assert k.std() > bound / 3  # uniform, not degenerate
+
+
+def test_remat_identical_numerics():
+    """remat=True recomputes activations in backward but must not change the
+    forward output or the gradients."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from simclr_pytorch_distributed_tpu.models import SupConResNet
+
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((4, 16, 16, 3)), jnp.float32
+    )
+
+    outs = {}
+    for remat in (False, True):
+        model = SupConResNet(model_name="resnet10", remat=remat)
+        v = model.init(jax.random.key(0), jnp.zeros((2, 16, 16, 3)), train=False)
+
+        def loss(params):
+            feats, _ = model.apply(
+                {"params": params, "batch_stats": v["batch_stats"]},
+                x, train=True, mutable=["batch_stats"],
+            )
+            return jnp.sum(jnp.square(feats))
+
+        val, grads = jax.value_and_grad(loss)(v["params"])
+        outs[remat] = (float(val), grads)
+
+    np.testing.assert_allclose(outs[True][0], outs[False][0], rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(outs[False][1]), jax.tree.leaves(outs[True][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
