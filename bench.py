@@ -128,9 +128,12 @@ def main():
         window_dts.append(time.perf_counter() - t0)
 
     def implied_mfu(dt_window: float) -> float:
+        # cost_analysis() on an SPMD-partitioned executable reports the
+        # PER-DEVICE module's FLOPs, so the per-chip MFU is flops/dt/peak
+        # with no n_chips factor (on 1 chip the two conventions coincide).
         if flops <= 0:
             return 0.0
-        return (flops * n_steps / dt_window) / (peak_tflops * 1e12 * n_chips)
+        return (flops * n_steps / dt_window) / (peak_tflops * 1e12)
 
     if flops <= 0:
         # No FLOP count -> the MFU cross-check cannot run, so the number
@@ -168,7 +171,7 @@ def main():
             "device_kind": device_kind,
             "total_imgs_per_sec": round(imgs_per_sec, 1),
             "step_ms": round(1000 * dt / n_steps, 2),
-            "flops_per_step": flops,
+            "flops_per_step_per_device": flops,
             "implied_mfu": round(mfu, 4),
             "peak_tflops_assumed": peak_tflops,
             "window_step_ms": [round(1000 * d / n_steps, 2) for d in window_dts],

@@ -126,7 +126,11 @@ def load_image_folder(
     arr_path = os.path.join(cache_root, f"{key}.npy")
     meta_path = os.path.join(cache_root, f"{key}.json")
 
-    if not (os.path.exists(arr_path) and os.path.exists(meta_path)):
+    # Hit check keys on arr_path alone: os.replace commits the array whole,
+    # and meta.json is a debugging aid never read on the load path — requiring
+    # it too would re-decode a fully-committed cache after a crash between
+    # the two writes.
+    if not os.path.exists(arr_path):
         # unique per-process temp name: concurrent decoders of the same tree
         # (e.g. pretrain + probe sharing --data_folder) race benignly — each
         # writes its own file and os.replace commits whole files atomically
@@ -140,8 +144,10 @@ def load_image_folder(
         out.flush()
         del out
         os.replace(tmp_path, arr_path)  # atomic: no half-decoded cache
-        with open(meta_path, "w") as f:
+        fd, meta_tmp = tempfile.mkstemp(suffix=".json.tmp", dir=cache_root)
+        with os.fdopen(fd, "w") as f:
             json.dump({"n": n, "store": s, "root": os.path.abspath(root)}, f)
+        os.replace(meta_tmp, meta_path)
 
     images = np.load(arr_path, mmap_mode="r")
     return {"images": images, "labels": labels_arr}, classes

@@ -126,8 +126,16 @@ class EpochLoader:
         sentinel = object()
 
         def worker():
-            for item in self._batches(epoch):
-                q.put(item)
+            # A raise here must not strand the consumer in q.get(): ship the
+            # exception through the queue and re-raise it on the training
+            # thread, where it can abort the step (and, multi-host, the job)
+            # with a real traceback instead of a collective timeout.
+            try:
+                for item in self._batches(epoch):
+                    q.put(item)
+            except BaseException as e:  # noqa: BLE001 — forwarded, not handled
+                q.put(e)
+                return
             q.put(sentinel)
 
         t = threading.Thread(target=worker, daemon=True)
@@ -136,6 +144,9 @@ class EpochLoader:
             item = q.get()
             if item is sentinel:
                 break
+            if isinstance(item, BaseException):
+                t.join()
+                raise item
             yield item
         t.join()
 

@@ -74,12 +74,18 @@ class SupConResNet(nn.Module):
     dtype: Any = jnp.float32
     axis_name: Optional[str] = None
     sync_bn: bool = True
+    # per-device BN when sync_bn=False: groups = data-parallel degree, views=2
+    # (the step's view-major two-crop layout; models/norm.py)
+    bn_local_groups: int = 1
+    bn_group_views: int = 2
     remat: bool = False  # per-block activation remat (models/resnet.py)
 
     def setup(self):
         model_fn, dim_in = MODEL_DICT[self.model_name]
         self.encoder = model_fn(
             dtype=self.dtype, axis_name=self.axis_name, sync_bn=self.sync_bn,
+            bn_local_groups=self.bn_local_groups,
+            bn_group_views=self.bn_group_views,
             remat=self.remat,
         )
         self.proj_head = ProjectionHead(
@@ -102,6 +108,9 @@ class SupCEResNet(nn.Module):
     num_classes: int = 10
     dtype: Any = jnp.float32
     axis_name: Optional[str] = None
+    # always-global BN: the reference's CE entry (main_ce.py, a 68-line stub
+    # after the fork) has no --syncBN flag or DDP wrap, so there is no
+    # per-device-BN semantic to reproduce on this path
     sync_bn: bool = True
 
     def setup(self):

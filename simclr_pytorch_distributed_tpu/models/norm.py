@@ -17,6 +17,15 @@ This module therefore implements plain batch statistics plus:
   ``pmap``), where stats are combined with ``lax.pmean`` — this is the
   per-device-program equivalent of SyncBatchNorm and also what a multi-host
   data-parallel step uses across the ``data`` axis;
+- a grouped per-device mode (``sync=False, local_groups=G``) reproducing the
+  reference's DEFAULT non-``--syncBN`` semantics (``main_supcon.py:223-224``
+  converts to SyncBN only when the flag is given; otherwise each GPU's
+  ``BatchNorm2d`` normalizes with its own local-batch statistics). Under GSPMD
+  there are no per-device programs to scope the statistics to, so the batch is
+  reshaped into G groups matching the per-device slices and statistics are
+  computed per group. Running stats follow group 0 — DDP's default
+  ``broadcast_buffers=True`` re-broadcasts rank 0's BN buffers at every
+  forward, so rank 0's local statistics ARE the persistent ones upstream;
 - fp32 statistics regardless of compute dtype (bf16 activations are normalized
   with fp32 mean/var, matching what mixed-precision SyncBN does).
 """
@@ -43,6 +52,15 @@ class CrossReplicaBatchNorm(nn.Module):
         where sharded-batch statistics are already global.
       sync: if False, skip the ``axis_name`` reduction even when provided —
         reproduces the reference's non-``--syncBN`` per-device BN semantics.
+      local_groups: per-device BN under GSPMD jit (``axis_name=None``): when
+        ``sync=False`` and ``local_groups=G > 1``, the batch is split into G
+        groups (the data-parallel device slices) and each group normalizes
+        with its OWN statistics — the reference's default per-GPU BN.
+      group_views: view-major folds in the leading axis. The train step flattens
+        the two crops view-major (``[v1 rows | v2 rows]``, supcon_step.py), while
+        the reference's per-GPU batch holds BOTH views of its image slice —
+        ``group_views=2`` makes group g = {view-1 slice g} ∪ {view-2 slice g},
+        matching that composition exactly.
     """
 
     momentum: float = 0.1
@@ -50,6 +68,8 @@ class CrossReplicaBatchNorm(nn.Module):
     use_running_average: bool = False
     axis_name: Optional[str] = None
     sync: bool = True
+    local_groups: int = 1
+    group_views: int = 1
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -71,6 +91,51 @@ class CrossReplicaBatchNorm(nn.Module):
         )
 
         xf = x.astype(jnp.float32)
+        grouped = (
+            not use_ra
+            and not self.sync
+            and self.axis_name is None
+            and self.local_groups > 1
+            # init traces with a tiny example batch (e.g. 2 rows) that need
+            # not divide into the groups; shapes/params don't depend on the
+            # statistics path, so init uses the whole-batch branch
+            and not self.is_initializing()
+        )
+        if grouped:
+            # Per-device BN under one GSPMD program: statistics scoped to the
+            # G data-parallel slices instead of the global batch. The [G, C]
+            # stats may straddle shard boundaries — XLA inserts tiny
+            # reductions; semantics (the reference's default per-GPU BN, not
+            # perf) is the point of this mode.
+            v, g = self.group_views, self.local_groups
+            n = x.shape[0]
+            if n % (v * g):
+                raise ValueError(
+                    f"batch {n} not divisible into {v} views x {g} BN groups"
+                )
+            spatial = 1
+            for a in range(1, x.ndim - 1):
+                spatial *= x.shape[a]
+            count = (n // g) * spatial
+            xg = xf.reshape((v, g, n // (v * g)) + x.shape[1:])
+            red = (0,) + tuple(range(2, xg.ndim - 1))
+            mean = jnp.mean(xg, axis=red)  # [G, C]
+            mean_sq = jnp.mean(jnp.square(xg), axis=red)
+            var = mean_sq - jnp.square(mean)  # biased, per group
+            if not self.is_initializing():
+                # Running stats track group 0: DDP's broadcast_buffers=True
+                # re-broadcasts rank 0's BN buffers every forward, so rank 0's
+                # local statistics are the persistent ones in the reference.
+                unbiased_var0 = var[0] * (count / max(count - 1, 1))
+                m = self.momentum
+                ra_mean.value = (1.0 - m) * ra_mean.value + m * mean[0]
+                ra_var.value = (1.0 - m) * ra_var.value + m * unbiased_var0
+            bshape = (1, g) + (1,) * (xg.ndim - 3) + (num_features,)
+            yg = (xg - mean.reshape(bshape)) * jax.lax.rsqrt(
+                var.reshape(bshape) + self.epsilon
+            )
+            y = yg.reshape(x.shape) * scale + bias
+            return y.astype(self.dtype or x.dtype)
         if use_ra:
             mean, var = ra_mean.value, ra_var.value
         else:

@@ -110,6 +110,10 @@ class ResNet(nn.Module):
     dtype: Any = jnp.float32
     axis_name: Optional[str] = None
     sync_bn: bool = True
+    # per-device BN groups under GSPMD when sync_bn=False (the reference's
+    # default per-GPU BatchNorm2d; see models/norm.py); 1 = whole-batch stats
+    bn_local_groups: int = 1
+    bn_group_views: int = 1
     # activation rematerialization per residual block: backward recomputes
     # each block's activations instead of keeping them in HBM — the standard
     # FLOPs-for-memory trade for bigger per-chip batches (identical numerics)
@@ -118,7 +122,8 @@ class ResNet(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
         norm = partial(
-            CrossReplicaBatchNorm, axis_name=self.axis_name, sync=self.sync_bn
+            CrossReplicaBatchNorm, axis_name=self.axis_name, sync=self.sync_bn,
+            local_groups=self.bn_local_groups, group_views=self.bn_group_views,
         )
         block_cls = (
             nn.remat(self.block_cls, static_argnums=(2,))

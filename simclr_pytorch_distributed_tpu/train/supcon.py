@@ -104,10 +104,23 @@ def resolve_loss_impl(loss_impl: str, batch_size: int, n_devices: int) -> str:
 def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1):
     """Model, schedule, optimizer, initial state, and the fused jitted update."""
     dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
+    # --syncBN off = the reference's default per-GPU BatchNorm2d
+    # (main_supcon.py:223-224 converts to SyncBN only when the flag is given):
+    # BN statistics are scoped to the data-parallel device slices, not the
+    # global batch (models/norm.py grouped mode).
+    data_parallel = max(1, n_devices // max(1, cfg.model_parallel))
     model = SupConResNet(
         model_name=cfg.model, head=cfg.head, feat_dim=cfg.feat_dim,
         dtype=dtype, sync_bn=cfg.syncBN, remat=cfg.remat,
+        bn_local_groups=1 if cfg.syncBN else data_parallel,
     )
+    if float(cfg.ngpu) != float(n_devices):
+        logging.warning(
+            "grad_div=%d (--ngpu) but the mesh has %d devices: gradients are "
+            "divided by %d for recipe fidelity with the reference's %d-GPU "
+            "runs; pass --ngpu %d if you want this mesh's own scaling",
+            cfg.ngpu, n_devices, cfg.ngpu, cfg.ngpu, n_devices,
+        )
     schedule = make_lr_schedule(
         learning_rate=cfg.learning_rate, epochs=cfg.epochs,
         steps_per_epoch=steps_per_epoch, cosine=cfg.cosine,

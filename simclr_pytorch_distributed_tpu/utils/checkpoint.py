@@ -162,12 +162,19 @@ def resolve_resume_path(path: str) -> str:
                 continue  # corrupt marker: skip, fall back to older complete saves
             epoch = meta.get("epoch")
             if epoch is not None:
-                candidates.append((int(epoch), os.path.join(path, name)))
+                # Epoch ties are broken EXPLICITLY in favour of scheduled
+                # saves (ckpt_*/last) over emergency crash_* saves — a crash
+                # save at the same recorded epoch holds at best the same
+                # state, and may predate the scheduled save's optimizer I/O.
+                scheduled = 0 if name.startswith("crash") else 1
+                candidates.append(
+                    (int(epoch), scheduled, os.path.join(path, name))
+                )
     if not candidates:
         raise FileNotFoundError(
             f"{path} contains no complete checkpoint (no */{META_FILE})"
         )
-    return max(candidates)[1]
+    return max(candidates)[2]
 
 
 def restore_checkpoint(path: str, abstract_state) -> Tuple[Any, dict]:

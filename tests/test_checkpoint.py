@@ -116,6 +116,19 @@ def test_resolve_resume_picks_latest_complete(tmp_path):
         resolve_resume_path(str(tmp_path / "empty_nothing_here"))
 
 
+def test_resolve_resume_epoch_tie_prefers_scheduled_save(tmp_path):
+    """crash_epoch_N+1 records epoch N, tying with ckpt_epoch_N: the
+    scheduled save wins the tie explicitly (not by path lexicography)."""
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        resolve_resume_path,
+    )
+
+    _, _, state = small_state()
+    p_ckpt = save_checkpoint(str(tmp_path), "ckpt_epoch_4", state, epoch=4)
+    save_checkpoint(str(tmp_path), "crash_epoch_5", state, epoch=4)
+    assert resolve_resume_path(str(tmp_path)) == p_ckpt
+
+
 def test_warm_start_accepts_run_dir_and_model_only(tmp_path):
     """--ckpt takes a run dir (resolved to latest complete) or a bare
     model-only payload dir (no meta.json needed for variables-only loads)."""

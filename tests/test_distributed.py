@@ -284,3 +284,26 @@ def test_tp_with_ring_loss_at_scale():
     )
     for a, b in zip(jax.tree.leaves(ref_state.params), jax.tree.leaves(new_state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3)
+
+
+def test_per_device_bn_step_on_mesh():
+    """--syncBN off (the reference default: per-GPU BatchNorm2d) through the
+    full GSPMD step: runs on the 8-device mesh, and its loss DIFFERS from the
+    synchronized-BN step's — the flag must do something (round-2 weak #2)."""
+    model, tx, schedule, cfg, state, images, labels = tiny_setup()
+    mesh = create_mesh()
+    local_model = SupConResNet(
+        model_name="resnet18", sync_bn=False, bn_local_groups=mesh.shape["data"]
+    )
+
+    step_sync = make_sharded_train_step(
+        model, tx, schedule, cfg, mesh, state_shape=state, donate=False
+    )
+    step_local = make_sharded_train_step(
+        local_model, tx, schedule, cfg, mesh, state_shape=state, donate=False
+    )
+    sh_images, sh_labels = shard_host_batch((images, labels), mesh)
+    _, m_sync = step_sync(state, sh_images, sh_labels)
+    _, m_local = step_local(state, sh_images, sh_labels)
+    assert np.isfinite(float(m_local["loss"]))
+    assert abs(float(m_local["loss"]) - float(m_sync["loss"])) > 1e-4

@@ -44,6 +44,90 @@ def test_eval_mode_uses_running_stats(rng):
     np.testing.assert_allclose(np.asarray(y), x / np.sqrt(1 + 1e-5), rtol=1e-5, atol=1e-6)
 
 
+def test_grouped_bn_matches_independent_per_shard_bn(rng):
+    """GSPMD per-device mode (sync=False, local_groups=G) == G INDEPENDENT
+    whole-batch BNs, one per data-parallel slice — the reference's default
+    per-GPU BatchNorm2d, expressible without per-device programs."""
+    g, v, per = 4, 2, 3  # groups x views x images-per-group-per-view
+    x = rng.normal(size=(v * g * per, 4, 4, 8)).astype(np.float32)
+    # make the groups statistically distinct
+    xv = x.reshape(v, g, per, 4, 4, 8)
+    xv += np.arange(g, dtype=np.float32)[None, :, None, None, None, None] * 5.0
+    x = xv.reshape(x.shape)
+
+    bn_grouped = CrossReplicaBatchNorm(sync=False, local_groups=g, group_views=v)
+    variables = bn_grouped.init(jax.random.key(0), jnp.asarray(x))
+    y, mut = bn_grouped.apply(variables, jnp.asarray(x), mutable=["batch_stats"])
+    y = np.asarray(y).reshape(v, g, per, 4, 4, 8)
+
+    bn_one = CrossReplicaBatchNorm()
+    for gi in range(g):
+        # group gi = both views of batch-slice gi, exactly the reference's
+        # per-GPU batch composition
+        xg = xv[:, gi].reshape(v * per, 4, 4, 8)
+        y_ref, mut_ref = bn_one.apply(
+            bn_one.init(jax.random.key(0), jnp.asarray(xg)),
+            jnp.asarray(xg), mutable=["batch_stats"],
+        )
+        np.testing.assert_allclose(
+            y[:, gi].reshape(v * per, 4, 4, 8), np.asarray(y_ref),
+            rtol=1e-4, atol=1e-5,
+        )
+        if gi == 0:
+            # running stats track group 0 (DDP broadcast_buffers semantics)
+            np.testing.assert_allclose(
+                np.asarray(mut["batch_stats"]["mean"]),
+                np.asarray(mut_ref["batch_stats"]["mean"]), rtol=1e-5, atol=1e-6,
+            )
+            np.testing.assert_allclose(
+                np.asarray(mut["batch_stats"]["var"]),
+                np.asarray(mut_ref["batch_stats"]["var"]), rtol=1e-4, atol=1e-5,
+            )
+
+    # and it differs from global-batch BN (the groups were made distinct)
+    y_global = np.asarray(bn_one.apply(variables, jnp.asarray(x), mutable=["batch_stats"])[0])
+    assert np.abs(y_global - y.reshape(y_global.shape)).max() > 0.5
+
+    # indivisible batch fails loudly instead of silently regrouping
+    with pytest.raises(ValueError, match="views"):
+        bn_grouped.apply(variables, jnp.asarray(x[:10]), mutable=["batch_stats"])
+
+
+def test_grouped_bn_init_with_tiny_example_batch():
+    """init() traces with a 2-row example batch that cannot divide into the
+    groups — the grouped branch must be inert during initialization (the
+    driver's create_train_state would otherwise crash every multi-device
+    sync-off run at startup)."""
+    bn = CrossReplicaBatchNorm(sync=False, local_groups=8, group_views=2)
+    variables = bn.init(jax.random.key(0), jnp.zeros((2, 4, 4, 3)))
+    assert variables["batch_stats"]["mean"].shape == (3,)
+
+
+@pytest.mark.slow
+def test_grouped_bn_identical_under_sharded_jit(rng):
+    """The grouped math is layout-independent: jit over the 8-device mesh with
+    the batch sharded on 'data' produces the same outputs and running stats."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    g = 8
+    x = rng.normal(size=(g * 2 * 2, 2, 2, 4)).astype(np.float32)
+    bn = CrossReplicaBatchNorm(sync=False, local_groups=g, group_views=2)
+    variables = bn.init(jax.random.key(0), jnp.asarray(x))
+
+    y_host, mut_host = bn.apply(variables, jnp.asarray(x), mutable=["batch_stats"])
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("data")))
+    y_jit, mut_jit = jax.jit(
+        lambda v, xx: bn.apply(v, xx, mutable=["batch_stats"])
+    )(variables, xs)
+    np.testing.assert_allclose(np.asarray(y_jit), np.asarray(y_host), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(mut_jit["batch_stats"]["var"]),
+        np.asarray(mut_host["batch_stats"]["var"]), rtol=1e-4, atol=1e-5,
+    )
+
+
 @pytest.mark.slow
 def test_shard_map_sync_equals_full_batch(rng):
     """pmean-synced per-device BN == BN over the concatenated batch — the
