@@ -38,25 +38,50 @@ PEAK_TFLOPS_BY_KIND = {
     "TPU v6e": 918.0,
 }
 DEFAULT_PEAK_TFLOPS = 197.0
+# Peak HBM bandwidth (GB/s) by device kind, public specs: v5e 819, v4 1228,
+# v5p 2765, v6e 1640. Used for the roofline: implied_hbm_util next to
+# implied_mfu says WHICH ceiling the workload is actually against.
+PEAK_HBM_GBPS_BY_KIND = {
+    "TPU v5 lite": 819.0,
+    "TPU v5e": 819.0,
+    "TPU v4": 1228.0,
+    "TPU v5p": 2765.0,
+    "TPU v6e": 1640.0,
+}
+DEFAULT_PEAK_HBM_GBPS = 819.0
 CREDIBLE_MFU = 0.70  # anything above this on this workload is a clock glitch
 
 
 def _compile_with_flops(update, *example_args):
-    """AOT-compile the update once; return (callable, XLA FLOPs/step or 0.0).
+    """AOT-compile the update once; return (callable, FLOPs/step, bytes/step).
 
-    Reusing the compiled executable avoids paying the big XLA compile twice
-    (once for cost analysis, once for the jit cache)."""
+    Both counts come from XLA's own cost analysis of the PER-DEVICE module
+    (0.0 when unavailable). Reusing the compiled executable avoids paying the
+    big XLA compile twice (once for cost analysis, once for the jit cache)."""
     try:
         compiled = update.lower(*example_args).compile()
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):  # older jax returns [dict]
             cost = cost[0] if cost else {}
-        return compiled, float(cost.get("flops", 0.0))
+        return (
+            compiled,
+            float(cost.get("flops", 0.0)),
+            float(cost.get("bytes accessed", 0.0)),
+        )
     except Exception:
-        return update, 0.0
+        return update, 0.0, 0.0
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser("throughput bench")
+    ap.add_argument(
+        "--stem", choices=["conv", "s2d"], default="conv",
+        help="s2d = space-to-depth stem repack A/B (docs/PERF.md roofline)",
+    )
+    args = ap.parse_args(argv)
+
     from simclr_pytorch_distributed_tpu.models import SupConResNet
     from simclr_pytorch_distributed_tpu.ops.augment import AugmentConfig
     from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
@@ -83,7 +108,8 @@ def main():
 
     # bf16 compute on the MXU; fp32 params/BN stats/loss.
     model = SupConResNet(
-        model_name="resnet50", head="mlp", feat_dim=128, dtype=jnp.bfloat16
+        model_name="resnet50", head="mlp", feat_dim=128, dtype=jnp.bfloat16,
+        stem=args.stem,
     )
     schedule = make_lr_schedule(
         learning_rate=0.5, epochs=100, steps_per_epoch=steps_per_epoch, cosine=True
@@ -106,9 +132,10 @@ def main():
     labels = rng.integers(0, 10, size=(batch,)).astype(np.int32)
     sh_images, sh_labels = shard_host_batch((images, labels), mesh)
 
-    update, flops = _compile_with_flops(
+    update, flops, bytes_accessed = _compile_with_flops(
         update, state, sh_images, sh_labels, jax.random.key(0)
     )
+    peak_hbm = PEAK_HBM_GBPS_BY_KIND.get(device_kind, DEFAULT_PEAK_HBM_GBPS)
 
     # warmup (compile + first steps); scalar readback = real sync (docstring)
     for i in range(3):
@@ -160,6 +187,14 @@ def main():
     imgs_per_sec = n_steps * batch / dt
     per_chip = imgs_per_sec / n_chips
     mfu = implied_mfu(dt)
+    # Roofline companion to MFU: fraction of peak HBM bandwidth the step's
+    # XLA-counted buffer traffic implies. "bytes accessed" is HLO-level
+    # (counts each logical buffer touch; fusion means actual DRAM traffic is
+    # lower), so this is an UPPER bound on true HBM utilization.
+    hbm_util = (
+        (bytes_accessed * n_steps / dt) / (peak_hbm * 1e9)
+        if bytes_accessed > 0 else 0.0
+    )
     print(json.dumps({
         "metric": "pretrain_imgs_per_sec_per_chip",
         "value": round(per_chip, 1),
@@ -172,13 +207,19 @@ def main():
             "total_imgs_per_sec": round(imgs_per_sec, 1),
             "step_ms": round(1000 * dt / n_steps, 2),
             "flops_per_step_per_device": flops,
+            "bytes_accessed_per_step_per_device": bytes_accessed,
             "implied_mfu": round(mfu, 4),
+            "implied_hbm_util_upper_bound": round(hbm_util, 4),
             "peak_tflops_assumed": peak_tflops,
+            "peak_hbm_gbps_assumed": peak_hbm,
             "window_step_ms": [round(1000 * d / n_steps, 2) for d in window_dts],
             "windows_discarded_as_clock_glitch": n_glitched,
             "clock_suspect": clock_suspect,
             "selection": "median of credible windows (implied MFU <= 0.7)",
-            "config": f"SimCLR rn50 cifar-recipe bf16 fused-aug loss={loss_impl}",
+            "config": (
+                f"SimCLR rn50 cifar-recipe bf16 fused-aug loss={loss_impl}"
+                + ("" if args.stem == "conv" else f" stem={args.stem}")
+            ),
         },
     }))
 

@@ -79,6 +79,7 @@ class SupConResNet(nn.Module):
     bn_local_groups: int = 1
     bn_group_views: int = 2
     remat: bool = False  # per-block activation remat (models/resnet.py)
+    stem: str = "conv"  # "s2d" = repacked stem experiment (models/resnet.py)
 
     def setup(self):
         model_fn, dim_in = MODEL_DICT[self.model_name]
@@ -86,7 +87,7 @@ class SupConResNet(nn.Module):
             dtype=self.dtype, axis_name=self.axis_name, sync_bn=self.sync_bn,
             bn_local_groups=self.bn_local_groups,
             bn_group_views=self.bn_group_views,
-            remat=self.remat,
+            remat=self.remat, stem=self.stem,
         )
         self.proj_head = ProjectionHead(
             head=self.head, dim_in=dim_in, feat_dim=self.feat_dim, dtype=self.dtype

@@ -35,6 +35,14 @@ from simclr_pytorch_distributed_tpu.models.norm import CrossReplicaBatchNorm
 conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
 
 
+# torch Conv2d(k=3, padding=1) pads (1,1) on each spatial dim. Flax's default
+# 'SAME' agrees at stride 1 but at stride 2 XLA pads (0,1), shifting every
+# window by one pixel vs torch — weight transplants from the reference would
+# silently diverge (caught by tests/test_torch_parity.py). Explicit padding
+# pins torch alignment; 1x1 convs use torch's padding=0 ('VALID').
+PAD3 = ((1, 1), (1, 1))
+
+
 class BasicBlock(nn.Module):
     """3x3 + 3x3 residual block, expansion 1 (reference resnet_big.py:7-34)."""
 
@@ -52,16 +60,19 @@ class BasicBlock(nn.Module):
             nn.Conv, use_bias=False, kernel_init=conv_kernel_init, dtype=self.dtype,
             param_dtype=jnp.float32,
         )
-        out = conv(self.planes, (3, 3), strides=(self.stride, self.stride))(x)
+        out = conv(
+            self.planes, (3, 3), strides=(self.stride, self.stride), padding=PAD3
+        )(x)
         out = nn.relu(norm(name="bn1")(out))
-        out = conv(self.planes, (3, 3))(out)
+        out = conv(self.planes, (3, 3), padding=PAD3)(out)
         out = norm(name="bn2")(out)
 
         shortcut = x
         if self.stride != 1 or x.shape[-1] != self.expansion * self.planes:
             shortcut = conv(
                 self.expansion * self.planes, (1, 1),
-                strides=(self.stride, self.stride), name="shortcut_conv",
+                strides=(self.stride, self.stride), padding="VALID",
+                name="shortcut_conv",
             )(x)
             shortcut = norm(name="shortcut_bn")(shortcut)
         return nn.relu(out + shortcut)
@@ -84,18 +95,21 @@ class Bottleneck(nn.Module):
             nn.Conv, use_bias=False, kernel_init=conv_kernel_init, dtype=self.dtype,
             param_dtype=jnp.float32,
         )
-        out = conv(self.planes, (1, 1))(x)
+        out = conv(self.planes, (1, 1), padding="VALID")(x)
         out = nn.relu(norm(name="bn1")(out))
-        out = conv(self.planes, (3, 3), strides=(self.stride, self.stride))(out)
+        out = conv(
+            self.planes, (3, 3), strides=(self.stride, self.stride), padding=PAD3
+        )(out)
         out = nn.relu(norm(name="bn2")(out))
-        out = conv(self.expansion * self.planes, (1, 1))(out)
+        out = conv(self.expansion * self.planes, (1, 1), padding="VALID")(out)
         out = norm(name="bn3")(out)
 
         shortcut = x
         if self.stride != 1 or x.shape[-1] != self.expansion * self.planes:
             shortcut = conv(
                 self.expansion * self.planes, (1, 1),
-                strides=(self.stride, self.stride), name="shortcut_conv",
+                strides=(self.stride, self.stride), padding="VALID",
+                name="shortcut_conv",
             )(x)
             shortcut = norm(name="shortcut_bn")(shortcut)
         return nn.relu(out + shortcut)
@@ -114,6 +128,14 @@ class ResNet(nn.Module):
     # default per-GPU BatchNorm2d; see models/norm.py); 1 = whole-batch stats
     bn_local_groups: int = 1
     bn_group_views: int = 1
+    # "conv": the reference 3x3/s1 stem. "s2d": 2x2 space-to-depth repacked
+    # stem (throughput experiment, NOT in the reference): the 3-channel conv
+    # wastes ~80% of the MXU's 128 input lanes (K=27 after im2col); repacking
+    # to [H/2, W/2, 12] and convolving 108->256 packed channels halves the
+    # padded MXU work, then depth-to-space restores [H, W, 64] so every later
+    # layer is unchanged. Slightly larger hypothesis class (6x6 receptive
+    # field); not weight-compatible with the reference stem.
+    stem: str = "conv"
     # activation rematerialization per residual block: backward recomputes
     # each block's activations instead of keeping them in HBM — the standard
     # FLOPs-for-memory trade for bigger per-chip batches (identical numerics)
@@ -130,11 +152,23 @@ class ResNet(nn.Module):
             if self.remat else self.block_cls
         )
         x = x.astype(self.dtype)
-        x = nn.Conv(
-            64, (3, 3), strides=(1, 1), use_bias=False,
-            kernel_init=conv_kernel_init, dtype=self.dtype, param_dtype=jnp.float32,
-            name="conv1",
-        )(x)
+        if self.stem == "s2d":
+            n, h, w, c = x.shape
+            x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+            x = nn.Conv(
+                4 * 64, (3, 3), strides=(1, 1), use_bias=False, padding=PAD3,
+                kernel_init=conv_kernel_init, dtype=self.dtype,
+                param_dtype=jnp.float32, name="conv1_s2d",
+            )(x)
+            x = x.reshape(n, h // 2, w // 2, 2, 2, 64)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h, w, 64)
+        else:
+            x = nn.Conv(
+                64, (3, 3), strides=(1, 1), use_bias=False, padding=PAD3,
+                kernel_init=conv_kernel_init, dtype=self.dtype,
+                param_dtype=jnp.float32, name="conv1",
+            )(x)
         x = nn.relu(norm(use_running_average=not train, name="bn1")(x))
         widths = (64, 128, 256, 512)
         strides = (1, 2, 2, 2)

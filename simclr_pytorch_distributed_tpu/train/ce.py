@@ -225,9 +225,14 @@ def run(cfg: config_lib.LinearConfig):
 def state_for_save(state: CEState):
     from simclr_pytorch_distributed_tpu.train.state import TrainState
 
+    # The placeholder scalar must inherit the step's mesh-replicated global
+    # sharding: a fresh jnp.zeros(()) is a host-local single-device array and
+    # orbax REFUSES to serialize those in a multi-process job (found by
+    # tests/test_multiprocess.py::test_two_process_ce_driver).
     return TrainState(
         step=state.step, params=state.params, batch_stats=state.batch_stats,
-        opt_state=state.opt_state, record_norm_mean=jnp.zeros((), jnp.float32),
+        opt_state=state.opt_state,
+        record_norm_mean=(state.step * 0).astype(jnp.float32),
     )
 
 

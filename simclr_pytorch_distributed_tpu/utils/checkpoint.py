@@ -34,6 +34,14 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import is_main_process
 
 META_FILE = "meta.json"
 
+# Stamped into meta.json so weight-incompatible model revisions are LOUD on
+# resume. Param trees can have identical shapes across revisions (so orbax
+# loads them without complaint) while the program means something different:
+# v2 = torch-aligned conv padding (models/resnet.py PAD3 — before this,
+# stride-2 convs used XLA SAME's (0,1) padding, shifting every window one
+# pixel, so pre-v2 checkpoints silently degrade under the current model).
+MODEL_LAYOUT_VERSION = 2
+
 # async saves in flight: each entry is one logical checkpoint —
 # (its checkpointers, its directory, its meta). meta.json is the "checkpoint
 # complete" marker consumers look at, so it is stamped only after THAT
@@ -122,7 +130,10 @@ def save_checkpoint(
         },
         block=block,
     )
-    meta = {"epoch": epoch, "config": config or {}}
+    meta = {
+        "epoch": epoch, "config": config or {},
+        "model_layout": MODEL_LAYOUT_VERSION,
+    }
     if block:
         _write_meta(path, meta)
     else:
@@ -212,6 +223,17 @@ def restore_checkpoint(path: str, abstract_state) -> Tuple[Any, dict]:
         )
     with open(meta_path) as f:
         meta = json.load(f)
+    saved_layout = meta.get("model_layout", 1)
+    if saved_layout != MODEL_LAYOUT_VERSION:
+        import logging
+
+        logging.warning(
+            "checkpoint %s was saved at model layout v%s but this build is "
+            "v%s (see MODEL_LAYOUT_VERSION in utils/checkpoint.py): the "
+            "param shapes load, but the weights were trained under different "
+            "conv semantics and accuracy will silently degrade",
+            path, saved_layout, MODEL_LAYOUT_VERSION,
+        )
     return state, meta
 
 

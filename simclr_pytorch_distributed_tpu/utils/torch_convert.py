@@ -1,0 +1,221 @@
+"""Reference-checkpoint interoperability: torch ``.pth`` -> orbax payload.
+
+The reference saves ``{'opt', 'model', 'optimizer', 'epoch'}`` via ``torch.save``
+(``util.py:87-96``), where ``'model'`` is the DDP-wrapped ``SupConResNet``
+state_dict — every key carries a ``'module.'`` prefix that the probe strips on
+load (``main_linear.py:125-142``). This module converts that layout into this
+framework's orbax ``model`` payload (``{'params', 'batch_stats'}``) so a
+reference-pretrained encoder can be probed/warm-started here directly:
+
+    python -m simclr_pytorch_distributed_tpu.utils.torch_convert \
+        path/to/ckpt_epoch_100.pth out_dir/
+    python main_linear.py --ckpt out_dir/ ...
+
+Layout mapping (torch ``resnet_big.py`` -> ``models/``):
+
+- conv weights OIHW -> HWIO (XLA:TPU's native conv kernel layout);
+- linear weights ``[out, in]`` -> ``[in, out]``;
+- ``bn.weight/bias`` -> ``params/../scale|bias``; ``running_mean/var`` ->
+  ``batch_stats/../mean|var``; ``num_batches_tracked`` dropped (torch keeps it
+  for momentum=None mode, never used by the reference's momentum=0.1 BNs);
+- ``encoder.layer{L}.{i}.conv{k}`` -> ``encoder/layer{L}_block{i}/Conv_{k-1}``,
+  ``shortcut.0/1`` -> ``shortcut_conv``/``shortcut_bn``;
+- ``head.0/head.2`` (mlp) -> ``proj_head/fc1|fc2``; ``head`` (linear) ->
+  ``proj_head/fc``.
+
+Architecture (resnet18/34/50/101, mlp/linear head) is inferred from the
+state_dict itself — no unpickling of the reference's argparse Namespace needed.
+torch is imported lazily: only conversion needs it, the framework does not.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, Tuple
+
+import numpy as np
+
+# torch layer index -> (stage sizes -> model name); resnet_big.py:121-142
+_STAGES_TO_NAME = {
+    (2, 2, 2, 2): "resnet18",
+    (3, 4, 6, 3): None,  # resnet34 (BasicBlock) or resnet50 (Bottleneck)
+    (3, 4, 23, 3): "resnet101",
+    (1, 1, 1, 1): "resnet10",  # this framework's smoke-test extension
+}
+
+
+def strip_module_prefix(state_dict: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Remove the DDP ``'module.'`` prefix (main_linear.py:129-133)."""
+    out = {}
+    for k, v in state_dict.items():
+        out[k[len("module."):] if k.startswith("module.") else k] = v
+    return out
+
+
+def infer_architecture(sd: Dict[str, np.ndarray]) -> Tuple[str, str, int]:
+    """(model_name, head, feat_dim) from state_dict keys/shapes alone."""
+    stages = []
+    for layer in (1, 2, 3, 4):
+        blocks = {
+            int(m.group(1))
+            for k in sd
+            if (m := re.match(rf"encoder\.layer{layer}\.(\d+)\.", k))
+        }
+        stages.append(max(blocks) + 1 if blocks else 0)
+    bottleneck = any(k.startswith("encoder.layer1.0.conv3") for k in sd)
+    stages = tuple(stages)
+    name = _STAGES_TO_NAME.get(stages)
+    if name is None and stages == (3, 4, 6, 3):
+        name = "resnet50" if bottleneck else "resnet34"
+    if name is None:
+        raise ValueError(f"unrecognized stage sizes {stages}")
+
+    if "head.0.weight" in sd:
+        head, feat_dim = "mlp", int(sd["head.2.weight"].shape[0])
+    elif "head.weight" in sd:
+        head, feat_dim = "linear", int(sd["head.weight"].shape[0])
+    else:
+        # A headless payload would convert "successfully" but then fail a
+        # late, cryptic orbax restore against SupConResNet's proj_head tree —
+        # fail loudly here instead.
+        raise ValueError(
+            "state_dict has no head.* keys (encoder-only checkpoint); the "
+            "reference's save_model always includes the projection head "
+            "(util.py:87-96), and --ckpt loads expect it"
+        )
+    return name, head, feat_dim
+
+
+def _set(tree: dict, path: Tuple[str, ...], value: np.ndarray) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+def _conv(w: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(np.transpose(w, (2, 3, 1, 0)))  # OIHW -> HWIO
+
+
+def torch_state_dict_to_variables(state_dict) -> dict:
+    """Reference ``SupConResNet`` state_dict -> ``{'params', 'batch_stats'}``.
+
+    Accepts torch tensors or numpy arrays; ``'module.'`` prefixes are stripped.
+    Raises on any unconsumed key so a layout drift cannot pass silently.
+    """
+    sd = {
+        k: (v.detach().cpu().numpy() if hasattr(v, "detach") else np.asarray(v))
+        for k, v in strip_module_prefix(state_dict).items()
+    }
+    params: dict = {}
+    stats: dict = {}
+    consumed = set()
+
+    def take(key: str) -> np.ndarray:
+        consumed.add(key)
+        return np.asarray(sd[key], np.float32)
+
+    def map_bn(src: str, dst: Tuple[str, ...]) -> None:
+        _set(params, dst + ("scale",), take(f"{src}.weight"))
+        _set(params, dst + ("bias",), take(f"{src}.bias"))
+        _set(stats, dst + ("mean",), take(f"{src}.running_mean"))
+        _set(stats, dst + ("var",), take(f"{src}.running_var"))
+        if f"{src}.num_batches_tracked" in sd:
+            consumed.add(f"{src}.num_batches_tracked")
+
+    def map_linear(src: str, dst: Tuple[str, ...]) -> None:
+        _set(params, dst + ("kernel",), take(f"{src}.weight").T.copy())
+        _set(params, dst + ("bias",), take(f"{src}.bias"))
+
+    for key in sd:
+        if key in consumed:
+            continue
+        if key == "encoder.conv1.weight":
+            _set(params, ("encoder", "conv1", "kernel"), _conv(take(key)))
+        elif key.startswith("encoder.bn1."):
+            map_bn("encoder.bn1", ("encoder", "bn1"))
+        elif m := re.match(r"encoder\.layer(\d)\.(\d+)\.(conv|bn)(\d)\.", key):
+            layer, block, kind, idx = m.groups()
+            scope = ("encoder", f"layer{layer}_block{block}")
+            if kind == "conv":
+                _set(
+                    params, scope + (f"Conv_{int(idx) - 1}", "kernel"),
+                    _conv(take(f"encoder.layer{layer}.{block}.conv{idx}.weight")),
+                )
+            else:
+                map_bn(f"encoder.layer{layer}.{block}.bn{idx}", scope + (f"bn{idx}",))
+        elif m := re.match(r"encoder\.layer(\d)\.(\d+)\.shortcut\.(\d)\.", key):
+            layer, block, idx = m.groups()
+            scope = ("encoder", f"layer{layer}_block{block}")
+            src = f"encoder.layer{layer}.{block}.shortcut.{idx}"
+            if idx == "0":
+                _set(params, scope + ("shortcut_conv", "kernel"), _conv(take(f"{src}.weight")))
+            else:
+                map_bn(src, scope + ("shortcut_bn",))
+        elif key.startswith("head.0."):
+            map_linear("head.0", ("proj_head", "fc1"))
+        elif key.startswith("head.2."):
+            map_linear("head.2", ("proj_head", "fc2"))
+        elif key.startswith("head.") and key.split(".")[1] in ("weight", "bias"):
+            map_linear("head", ("proj_head", "fc"))
+
+    leftover = set(sd) - consumed
+    if leftover:
+        raise ValueError(f"unmapped reference keys: {sorted(leftover)[:8]}")
+    return {"params": params, "batch_stats": stats}
+
+
+def convert_reference_checkpoint(pth_path: str, out_dir: str) -> dict:
+    """Load a reference ``.pth`` and write this framework's orbax payload.
+
+    Returns ``{'model_name', 'head', 'feat_dim', 'epoch', 'path'}``. The output
+    dir is directly consumable by ``--ckpt`` (``load_pretrained_variables``
+    accepts a dir holding a ``model`` payload).
+    """
+    import torch  # lazy: only conversion needs torch
+
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        _save_tree,
+        _write_meta,
+    )
+
+    ckpt = torch.load(pth_path, map_location="cpu", weights_only=False)
+    sd = ckpt["model"] if isinstance(ckpt, dict) and "model" in ckpt else ckpt
+    sd = strip_module_prefix({k: v for k, v in sd.items()})
+    model_name, head, feat_dim = infer_architecture(sd)
+    variables = torch_state_dict_to_variables(sd)
+
+    out_dir = os.path.abspath(out_dir)
+    _save_tree(os.path.join(out_dir, "model"), variables)
+    epoch = ckpt.get("epoch") if isinstance(ckpt, dict) else None
+    _write_meta(out_dir, {
+        "epoch": int(epoch) if epoch is not None else None,
+        "config": {
+            "model": model_name, "head": head, "feat_dim": feat_dim,
+            "converted_from": os.path.abspath(pth_path),
+        },
+    })
+    info = {
+        "model_name": model_name, "head": head, "feat_dim": feat_dim,
+        "epoch": epoch, "path": out_dir,
+    }
+    return info
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        "convert a reference torch .pth checkpoint to an orbax model payload"
+    )
+    p.add_argument("pth", help="reference checkpoint (util.py:87-96 layout)")
+    p.add_argument("out_dir", help="output dir, usable as --ckpt")
+    args = p.parse_args(argv)
+    info = convert_reference_checkpoint(args.pth, args.out_dir)
+    print(json.dumps(info))
+
+
+if __name__ == "__main__":
+    main()

@@ -41,8 +41,8 @@ if nproc > 1:
         process_id=pid,
     )
 
-if mode == "driver":
-    # full driver: tiny synthetic run through supcon.run; process 0 owns I/O
+if mode in ("driver", "driver_partial", "ce"):
+    # full drivers: tiny synthetic run; process 0 owns I/O
     from simclr_pytorch_distributed_tpu import config as config_lib
     from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib
 
@@ -52,19 +52,65 @@ if mode == "driver":
             n=128, num_classes=num_classes, seed=seed, size=8
         )
     )
+    workdir = sys.argv[5]
+
+    if mode == "ce":
+        # the CE driver shares broadcast_from_main/collective-save machinery
+        # that only supcon exercised before (round-2 weak #5)
+        from simclr_pytorch_distributed_tpu.train import ce as ce_driver
+
+        cfg = config_lib.LinearConfig(
+            model="resnet10", dataset="synthetic", batch_size=32, epochs=2,
+            learning_rate=0.05, save_freq=2, print_freq=2, size=8,
+            workdir=workdir, seed=0, trial="mpce",
+        )
+        cfg = config_lib.finalize_linear(cfg, prefix="ce_")
+        best_acc, _ = ce_driver.run(cfg)
+        print(f"CE best_acc={best_acc:.4f} save_folder={cfg.save_folder}",
+              flush=True)
+        sys.exit(0)
+
     from simclr_pytorch_distributed_tpu.train import supcon as supcon_driver
 
-    workdir = sys.argv[5]
+    epochs = int(sys.argv[6]) if len(sys.argv) > 6 else 2
+    resume = sys.argv[7] if len(sys.argv) > 7 else ""
     cfg = config_lib.SupConConfig(
-        model="resnet10", dataset="synthetic", batch_size=32, epochs=2,
+        model="resnet10", dataset="synthetic", batch_size=32, epochs=epochs,
         learning_rate=0.05, temp=0.5, cosine=True, syncBN=True,
         save_freq=2, print_freq=2, size=8, workdir=workdir, seed=0,
-        method="SimCLR", trial="mp",
+        method="SimCLR", trial="mp", resume=resume,
     )
     cfg = config_lib.finalize_supcon(cfg)
+
+    if mode == "driver_partial":
+        # simulated mid-job crash: die at the START of epoch 3, after the
+        # (async) epoch-2 scheduled save; run()'s finally drains the save
+        _orig_epoch = supcon_driver.train_one_epoch
+
+        def _patched(epoch, *a, **k):
+            if epoch == 3:
+                raise RuntimeError("simulated crash before epoch 3")
+            return _orig_epoch(epoch, *a, **k)
+
+        supcon_driver.train_one_epoch = _patched
+        try:
+            supcon_driver.run(cfg)
+            raise SystemExit("expected the simulated crash")
+        except RuntimeError:
+            print(f"PARTIAL save_folder={cfg.save_folder}", flush=True)
+            sys.exit(0)
+
     state = supcon_driver.run(cfg)
-    print(f"DRIVER step={int(state.step)} save_folder={cfg.save_folder}",
-          flush=True)
+    import jax as _jax
+
+    digest = sum(
+        float(abs(x).sum()) for x in _jax.tree.leaves(state.params)
+    )
+    print(
+        f"DRIVER step={int(state.step)} digest={digest:.6f} "
+        f"save_folder={cfg.save_folder}",
+        flush=True,
+    )
     sys.exit(0)
 
 import jax.numpy as jnp

@@ -29,7 +29,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_children(nproc: int, port: int):
+def _child_env():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -44,18 +44,14 @@ def _run_children(nproc: int, port: int):
     env.setdefault(
         "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
     )
-    procs = [
-        subprocess.Popen(
-            [sys.executable, CHILD, str(i), str(nproc), str(port)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True, cwd=REPO,
-        )
-        for i in range(nproc)
-    ]
+    return env
+
+
+def _reap(procs, timeout):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=540)
+            out, _ = p.communicate(timeout=timeout)
             assert p.returncode == 0, out
             outs.append(out)
     finally:
@@ -64,6 +60,19 @@ def _run_children(nproc: int, port: int):
             if p.poll() is None:
                 p.kill()
     return outs
+
+
+def _run_children(nproc: int, port: int):
+    env = _child_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, CHILD, str(i), str(nproc), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=REPO,
+        )
+        for i in range(nproc)
+    ]
+    return _reap(procs, 540)
 
 
 def _loss_of(out: str) -> float:
@@ -83,39 +92,81 @@ def test_two_process_step_matches_single_process():
     np.testing.assert_allclose(losses[0], ref, rtol=1e-6)
 
 
-def test_two_process_full_driver(tmp_path):
-    """The COMPLETE pretrain driver across two real processes: epoch loops,
-    per-process data shards, cross-process collectives, and process-0-gated
-    checkpoint/log I/O — the closest this host gets to a 2-host launch."""
+def _run_driver_children(tmp_path, mode, extra_args=(), timeout=540):
+    env = _child_env()
     port = _free_port()
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["XLA_FLAGS"] = " ".join(
-        f for f in env.get("XLA_FLAGS", "").split()
-        if "host_platform_device_count" not in f
-    )
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
     procs = [
         subprocess.Popen(
-            [sys.executable, CHILD, str(i), "2", str(port), "driver",
-             str(tmp_path)],
+            [sys.executable, CHILD, str(i), "2", str(port), mode,
+             str(tmp_path), *map(str, extra_args)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=REPO,
         )
         for i in range(2)
     ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=540)
-            assert p.returncode == 0, out
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    return _reap(procs, timeout)
+
+
+def _driver_line(out: str, tag: str = "DRIVER ") -> str:
+    lines = [l for l in out.splitlines() if l.startswith(tag)]
+    assert lines, out
+    return lines[0]
+
+
+def test_two_process_crash_resume_matches_uninterrupted(tmp_path):
+    """Kill-and-resume across BOTH processes (round-2 weak #5: restore is the
+    collective symmetric to save and had no multi-process test): a 4-epoch job
+    crashed at epoch 3 and resumed with --resume <run_dir> must land on the
+    same step AND the same parameters as an uninterrupted 4-epoch run."""
+    outs = _run_driver_children(tmp_path / "partial", "driver_partial", (4,))
+    run_dir = [
+        _driver_line(o, "PARTIAL ").split("save_folder=")[1] for o in outs
+    ]
+    assert run_dir[0] == run_dir[1]
+    # the simulated crash left the epoch-2 scheduled save complete
+    assert os.path.exists(os.path.join(run_dir[0], "ckpt_epoch_2", "meta.json"))
+
+    resumed = _run_driver_children(
+        tmp_path / "resumed", "driver", (4, run_dir[0])
+    )
+    straight = _run_driver_children(tmp_path / "straight", "driver", (4,))
+
+    def parse(o):
+        line = _driver_line(o)
+        return (
+            int(line.split("step=")[1].split()[0]),
+            float(line.split("digest=")[1].split()[0]),
+        )
+
+    (step_r, dig_r), (step_r2, dig_r2) = (parse(o) for o in resumed)
+    (step_s, dig_s), _ = (parse(o) for o in straight)
+    assert step_r == step_r2 == step_s == 12  # 3 steps/epoch x 4 epochs
+    assert dig_r == dig_r2
+    # identical post-resume parameters (CPU math is deterministic; the
+    # schedule/data/aug streams are pure functions of the global step)
+    np.testing.assert_allclose(dig_r, dig_s, rtol=1e-6)
+
+
+def test_two_process_ce_driver(tmp_path):
+    """The CE driver across two real processes (it shares the
+    broadcast_from_main + collective-save machinery only supcon exercised)."""
+    outs = _run_driver_children(tmp_path, "ce")
+    accs = []
+    folders = []
+    for out in outs:
+        line = _driver_line(out, "CE ")
+        accs.append(float(line.split("best_acc=")[1].split()[0]))
+        folders.append(line.split("save_folder=")[1])
+    assert accs[0] == accs[1]
+    assert folders[0] == folders[1]
+    assert os.path.exists(os.path.join(folders[0], "ckpt_epoch_2", "meta.json"))
+
+
+def test_two_process_full_driver(tmp_path):
+    """The COMPLETE pretrain driver across two real processes: epoch loops,
+    per-process data shards, cross-process collectives, and process-0-gated
+    checkpoint/log I/O — the closest this host gets to a 2-host launch."""
+    outs = _run_driver_children(tmp_path, "driver")
 
     steps = []
     folders = []

@@ -1,0 +1,76 @@
+"""ImageNet-scale config certification (BASELINE.json configs[4]) as a dryrun.
+
+Round-2 verdict item 7: the large-recipe parts — LARS, the ring-sharded loss,
+tensor parallelism, and the memmap ImageFolder path — were each tested alone
+but never driven TOGETHER through the real pretrain driver. This test runs
+``train/supcon.run`` with ``--optimizer lars --loss_impl ring
+--model_parallel 2`` at GLOBAL BATCH 4096 over the virtual 8-device mesh on a
+memmap-cached ``--dataset path`` tree: compile + 2 steps, finite result,
+host RSS bounded by the memmap (not anonymous RAM).
+"""
+
+import os
+import resource
+
+import jax
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def _write_ppm_tree(root, n_per_class=2080, classes=("a", "b"), px=8):
+    """Tiny ImageFolder tree of raw P6 .ppm files (fast to write + PIL-readable)."""
+    rng = np.random.default_rng(0)
+    header = f"P6\n{px} {px}\n255\n".encode()
+    for cls in classes:
+        d = os.path.join(root, cls)
+        os.makedirs(d)
+        for i in range(n_per_class):
+            body = rng.integers(0, 256, px * px * 3, dtype=np.uint8).tobytes()
+            with open(os.path.join(d, f"{i:05d}.ppm"), "wb") as f:
+                f.write(header + body)
+
+
+def test_imagenet_scale_config_drives_end_to_end(tmp_path):
+    from simclr_pytorch_distributed_tpu import config as config_lib
+    from simclr_pytorch_distributed_tpu.train import supcon as supcon_driver
+
+    data_root = tmp_path / "tree"
+    _write_ppm_tree(str(data_root))  # 4160 images -> 1 global step/epoch @ 4096
+
+    cfg = config_lib.SupConConfig(
+        model="resnet10", dataset="path", data_folder=str(data_root),
+        mean="(0.5, 0.5, 0.5)", std="(0.25, 0.25, 0.25)",
+        batch_size=4096, epochs=2, learning_rate=0.5, temp=0.5, cosine=True,
+        syncBN=True, optimizer="lars", loss_impl="ring", model_parallel=2,
+        size=8, store_size=8, mmap_threshold_mb=0,  # force the memmap cache
+        save_freq=2, print_freq=1, workdir=str(tmp_path / "work"), seed=0,
+        method="SimCLR", trial="scale", ngpu=8,
+    )
+    cfg = config_lib.finalize_supcon(cfg)
+
+    # the loader must actually take the memmap path at this threshold
+    from simclr_pytorch_distributed_tpu.data.cifar import load_dataset
+
+    train_data, _, _ = load_dataset(
+        "path", str(data_root), size=8, store_size=8, mmap_threshold_mb=0
+    )
+    assert isinstance(train_data["images"], np.memmap)
+    assert len(train_data["images"]) == 4160
+
+    state = supcon_driver.run(cfg)
+
+    # 4160 // 4096 = 1 step/epoch x 2 epochs; nan_guard (default on) would
+    # have raised on any non-finite loss, so arrival here == finite steps
+    assert int(state.step) == 2
+    for leaf in jax.tree.leaves(state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # checkpoints written through the same run
+    assert os.path.exists(os.path.join(cfg.save_folder, "last", "meta.json"))
+
+    # bounded host footprint: the decoded tree rides the page cache, and the
+    # whole driver (incl. XLA compile of the 8192-row ring program) stays
+    # far below what an in-RAM ImageNet-scale decode would need
+    rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    assert rss_gb < 10.0, f"RSS {rss_gb:.1f} GB"

