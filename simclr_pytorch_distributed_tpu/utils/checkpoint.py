@@ -223,6 +223,11 @@ def restore_checkpoint(path: str, abstract_state) -> Tuple[Any, dict]:
         )
     with open(meta_path) as f:
         meta = json.load(f)
+    _warn_layout_mismatch(path, meta)
+    return state, meta
+
+
+def _warn_layout_mismatch(path: str, meta: dict) -> None:
     saved_layout = meta.get("model_layout", 1)
     if saved_layout != MODEL_LAYOUT_VERSION:
         import logging
@@ -234,7 +239,6 @@ def restore_checkpoint(path: str, abstract_state) -> Tuple[Any, dict]:
             "conv semantics and accuracy will silently degrade",
             path, saved_layout, MODEL_LAYOUT_VERSION,
         )
-    return state, meta
 
 
 def save_classifier(save_folder: str, params, best_acc: float) -> str:
@@ -261,8 +265,30 @@ def load_pretrained_variables(path: str, abstract_variables: dict) -> dict:
     directory too (resolved to its latest complete checkpoint), so ``--ckpt``
     and ``--resume`` take the same kinds of paths. A dir that directly holds a
     ``model`` payload is used as-is — meta.json completeness only gates FULL
-    resume, not model-only loads (e.g. hand-built encoder checkpoints)."""
+    resume, not model-only loads (e.g. hand-built encoder checkpoints).
+
+    A reference ``.pth`` file (torch.save layout, util.py:87-96) is accepted
+    directly: it is converted in place to ``<file>.converted/`` on first use
+    (utils/torch_convert.py) and loaded from there — ``--ckpt ref.pth`` just
+    works."""
     path = os.path.abspath(path)
+    if os.path.isfile(path):
+        out_dir = path + ".converted"
+        if not os.path.isdir(os.path.join(out_dir, "model")):
+            # multi-process: exactly one writer (orbax force=True DELETES an
+            # existing target, so concurrent converters can clobber each
+            # other), and a barrier so nobody restores a half-written payload
+            from simclr_pytorch_distributed_tpu.parallel.mesh import (
+                sync_processes,
+            )
+            from simclr_pytorch_distributed_tpu.utils.torch_convert import (
+                convert_reference_checkpoint,
+            )
+
+            if is_main_process():
+                convert_reference_checkpoint(path, out_dir)
+            sync_processes("pth_convert")
+        path = out_dir
     if not os.path.isdir(os.path.join(path, "model")):
         try:
             path = resolve_resume_path(path)
@@ -280,6 +306,16 @@ def load_pretrained_variables(path: str, abstract_variables: dict) -> dict:
                 path = max(subs, key=os.path.getmtime)
             else:
                 raise
+    # The layout check must cover THIS path too — warm-start/probe loads are
+    # the primary way an old encoder gets reused. Bare payload dirs without
+    # meta.json (hand-built) are exempt.
+    meta_path = os.path.join(path, META_FILE)
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                _warn_layout_mismatch(path, json.load(f))
+        except ValueError:
+            pass
     return _restore_tree(
         os.path.join(path, "model"),
         _abstract({"params": abstract_variables["params"],

@@ -177,6 +177,7 @@ def convert_reference_checkpoint(pth_path: str, out_dir: str) -> dict:
     import torch  # lazy: only conversion needs torch
 
     from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        MODEL_LAYOUT_VERSION,
         _save_tree,
         _write_meta,
     )
@@ -192,6 +193,8 @@ def convert_reference_checkpoint(pth_path: str, out_dir: str) -> dict:
     epoch = ckpt.get("epoch") if isinstance(ckpt, dict) else None
     _write_meta(out_dir, {
         "epoch": int(epoch) if epoch is not None else None,
+        # torch weights are padding=1 semantics == this build's v2 layout
+        "model_layout": MODEL_LAYOUT_VERSION,
         "config": {
             "model": model_name, "head": head, "feat_dim": feat_dim,
             "converted_from": os.path.abspath(pth_path),

@@ -373,6 +373,58 @@ def test_reference_checkpoint_converts_and_loads(ref_resnet_big, tmp_path):
     )
     np.testing.assert_allclose(np.asarray(feat_j), feat_t, rtol=1e-3, atol=1e-4)
 
+    # and the .pth FILE itself is a valid --ckpt argument (auto-converted)
+    direct = load_pretrained_variables(str(pth), abstract)
+    for a, b in zip(jax.tree.leaves(direct), jax.tree.leaves(variables)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_topk_accuracy_matches_reference(ref_util):
+    """ops.metrics.topk_accuracy vs the reference's accuracy() (util.py:37-51).
+
+    Quirk pinned here: on the installed (modern) torch, the reference's own
+    ``correct[:k].view(-1)`` CRASHES for maxk>1 — elementwise ``eq`` preserves
+    the transposed striding, so the view is illegal. The reference probe would
+    therefore crash calling ``accuracy(..., topk=(1, 5))`` on this torch. We
+    oracle-test k=1 (where the reference runs), verify the maxk>1 crash, and
+    check (1, 5) against the standard ``.reshape`` repair of the same code."""
+    from simclr_pytorch_distributed_tpu.ops.metrics import topk_accuracy
+
+    rng = np.random.default_rng(21)
+    logits = rng.normal(size=(64, 10)).astype(np.float32)
+    target = rng.integers(0, 10, 64)
+    lt, tt = torch.tensor(logits), torch.tensor(target)
+    ours = topk_accuracy(jnp.asarray(logits), jnp.asarray(target), topk=(1, 5))
+
+    (ref1,) = ref_util.accuracy(lt, tt, topk=(1,))
+    np.testing.assert_allclose(float(ours[0]), float(ref1.item()), rtol=1e-6)
+
+    with pytest.raises(RuntimeError, match="view size"):
+        ref_util.accuracy(lt, tt, topk=(1, 5))
+
+    # the reference algorithm with the one-token repair (view -> reshape)
+    maxk = 5
+    _, pred = lt.topk(maxk, 1, True, True)
+    pred = pred.t()
+    correct = pred.eq(tt.view(1, -1).expand_as(pred))
+    for k, o in zip((1, 5), ours):
+        ref_k = correct[:k].reshape(-1).float().sum(0) * (100.0 / len(target))
+        np.testing.assert_allclose(float(o), float(ref_k.item()), rtol=1e-6)
+
+
+def test_average_meter_matches_reference(ref_util):
+    from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
+
+    ours, ref = AverageMeter(), ref_util.AverageMeter()
+    rng = np.random.default_rng(22)
+    for _ in range(17):
+        v, n = float(rng.normal()), int(rng.integers(1, 9))
+        ours.update(v, n)
+        ref.update(v, n)
+    assert ours.count == ref.count
+    np.testing.assert_allclose(ours.avg, ref.avg, rtol=1e-12)
+    np.testing.assert_allclose(ours.val, ref.val, rtol=1e-12)
+
 
 def test_infer_architecture_variants(ref_resnet_big):
     for name, head, feat in [("resnet18", "mlp", 128), ("resnet34", "linear", 64)]:
