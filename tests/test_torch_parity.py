@@ -268,6 +268,82 @@ def test_weight_transplant_forward_parity_resnet50(ref_resnet_big):
     np.testing.assert_allclose(np.asarray(out_j), out_t, rtol=1e-3, atol=2e-4)
 
 
+def test_full_train_step_gradient_parity(ref_losses, ref_resnet_big):
+    """END-TO-END gradient parity of the reference's training computation:
+    two-crop batch -> encoder -> head -> row-normalize -> SupConLoss,
+    differentiated through the WHOLE chain (train-mode BN) on transplanted
+    weights. main_supcon.py:276-290 composition on the torch side; our
+    two_view_forward + supcon_loss on the JAX side. Input gradients AND
+    representative parameter gradients must agree."""
+    import torch.nn.functional as F
+
+    from simclr_pytorch_distributed_tpu.train.supcon_step import (
+        two_view_forward,
+    )
+
+    b, s, temp = 8, 16, 0.5
+    tm, fm, variables = _transplanted_pair(ref_resnet_big, "resnet18")
+    tm.train()
+    criterion = ref_losses.SupConLoss(temperature=temp)
+
+    x = np.random.default_rng(31).normal(size=(b, 2, 3, s, s)).astype(np.float32)
+
+    # ---- torch side (reference composition, main_supcon.py:276-290)
+    xt = torch.tensor(x, requires_grad=True)
+    cat = torch.cat([xt[:, 0], xt[:, 1]], dim=0)  # view-major [2B, 3, H, W]
+    feats_t = F.normalize(tm(cat), dim=1)
+    f1, f2 = torch.split(feats_t, [b, b], dim=0)
+    stacked = torch.cat([f1.unsqueeze(1), f2.unsqueeze(1)], dim=1)
+    loss_t = criterion(stacked)
+    loss_t.backward()
+
+    # ---- jax side (our step's forward, ops losses), same weights
+    x_nhwc = jnp.asarray(np.transpose(x, (0, 1, 3, 4, 2)))  # [B, 2, H, W, C]
+
+    def loss_fn(params, xx):
+        feats, _ = two_view_forward(
+            fm, params, variables["batch_stats"], xx, train=True
+        )
+        feats = feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
+        fbvd = jnp.transpose(feats.reshape(2, b, -1), (1, 0, 2))
+        return supcon_loss(fbvd, temperature=temp, base_temperature=0.07)
+
+    val, (g_params, g_x) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+        variables["params"], x_nhwc
+    )
+    np.testing.assert_allclose(float(val), float(loss_t.detach()), rtol=1e-4)
+
+    # input gradients: the full backward chain in one number. XLA and torch
+    # accumulate 20+ layers of fp32 in different orders, so tiny elements
+    # drift to ~1e-3 relative — compare direction + relative L2 error.
+    g_x_t = np.transpose(xt.grad.numpy(), (0, 1, 3, 4, 2)).ravel()
+    g_x_j = np.asarray(g_x).ravel()
+    rel_l2 = np.linalg.norm(g_x_j - g_x_t) / np.linalg.norm(g_x_t)
+    cos = g_x_j @ g_x_t / (np.linalg.norm(g_x_j) * np.linalg.norm(g_x_t))
+    assert rel_l2 < 5e-3, rel_l2
+    assert cos > 0.99999, cos
+
+    # representative parameter gradients across the depth of the network
+    named_t = dict(tm.named_parameters())
+    checks = [
+        (("encoder", "conv1", "kernel"), "encoder.conv1.weight", (2, 3, 1, 0)),
+        (("encoder", "bn1", "scale"), "encoder.bn1.weight", None),
+        (("encoder", "layer3_block0", "Conv_0", "kernel"),
+         "encoder.layer3.0.conv1.weight", (2, 3, 1, 0)),
+        (("proj_head", "fc2", "kernel"), "head.2.weight", (1, 0)),
+    ]
+    for jpath, tname, perm in checks:
+        gj = g_params
+        for k in jpath:
+            gj = gj[k]
+        gt = named_t[tname].grad.numpy()
+        if perm is not None:
+            gt = np.transpose(gt, perm)
+        gj, gt = np.asarray(gj).ravel(), gt.ravel()
+        rel = np.linalg.norm(gj - gt) / np.linalg.norm(gt)
+        assert rel < 5e-3, f"{tname}: rel L2 {rel}"
+
+
 # ------------------------------------------------------- schedules
 
 
