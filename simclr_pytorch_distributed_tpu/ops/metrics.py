@@ -69,17 +69,18 @@ class MetricBuffer:
 
     def flush(self):
         """Returns [(info, {name: float})] for all buffered steps; clears."""
-        import numpy as np
-
         if not self._steps:
             return []
         keys = sorted(self._steps[0][1])
-        stacked = np.asarray(
-            jnp.stack([jnp.stack([m[k] for k in keys]) for _, m in self._steps])
-        )  # [n_steps, n_keys] — a single readback
+        # jax.device_get on the plain nested list batches all the D2H copies
+        # into one async sweep WITHOUT building an XLA program — a jnp.stack
+        # here would compile a new program for every distinct (n_steps, n_keys)
+        # buffer shape (tail windows differ), which dominated driver runtime on
+        # the CPU test host.
+        fetched = jax.device_get([[m[k] for k in keys] for _, m in self._steps])
         out = [
             (info, dict(zip(keys, (float(v) for v in row))))
-            for (info, _), row in zip(self._steps, stacked)
+            for (info, _), row in zip(self._steps, fetched)
         ]
         self._steps = []
         return out

@@ -87,10 +87,12 @@ def test_supcon_resume(tmp_path):
 def test_ce_driver_end_to_end(tmp_path):
     # lr 0.1: lr=0.5 was on the edge of divergence for a from-scratch CNN on
     # 280 samples — tiny numeric perturbations flipped the trajectory between
-    # ~8% and ~20% val top-1. At lr 0.1 / 10 epochs the margin over the 30%
-    # bar is wide (observed 60-82% on rn18; rn10 passes with margin too).
+    # ~8% and ~20% val top-1. At lr 0.1 / 6 epochs the margin over the 30%
+    # bar is wide (72.5% observed on rn10 with this exact seed/config; 10
+    # epochs reached 60-82% on rn18 — trimmed to keep `pytest -m slow` inside
+    # a 10-minute harness budget).
     cfg = config_lib.LinearConfig(
-        model="resnet10", dataset="synthetic", batch_size=64, epochs=10,
+        model="resnet10", dataset="synthetic", batch_size=64, epochs=6,
         learning_rate=0.1, size=SIZE, val_batch_size=40, workdir=str(tmp_path),
         print_freq=100,
     )
