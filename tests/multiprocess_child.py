@@ -140,7 +140,10 @@ schedule = make_lr_schedule(
 tx = make_optimizer(schedule, momentum=0.9, weight_decay=1e-4)
 state = create_train_state(model, tx, jax.random.key(0), jnp.zeros((2, size, size, 3)))
 cfg = SupConStepConfig(
-    method="SimCLR", temperature=0.5, epochs=2, steps_per_epoch=2, grad_div=2.0
+    method="SimCLR", temperature=0.5, epochs=2, steps_per_epoch=2, grad_div=2.0,
+    # mode 'ring': the ppermute-rotating sharded loss across REAL process
+    # boundaries — the DP step only exercises psum/all-gather over gloo
+    loss_impl=("ring" if mode == "ring" else "dense"),
 )
 mesh = create_mesh()
 assert mesh.size == 2, mesh

@@ -62,17 +62,18 @@ def _reap(procs, timeout):
     return outs
 
 
-def _run_children(nproc: int, port: int):
+def _run_children(nproc: int, port: int, mode: str = "step"):
     env = _child_env()
     procs = [
         subprocess.Popen(
-            [sys.executable, CHILD, str(i), str(nproc), str(port)],
+            [sys.executable, CHILD, str(i), str(nproc), str(port), mode],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=REPO,
         )
         for i in range(nproc)
     ]
-    return _reap(procs, 540)
+    # generous: a chip job sharing this 1-core host can slow children 2-3x
+    return _reap(procs, 900)
 
 
 def _loss_of(out: str) -> float:
@@ -82,9 +83,15 @@ def _loss_of(out: str) -> float:
     raise AssertionError(f"no LOSS line in:\n{out}")
 
 
-def test_two_process_step_matches_single_process():
-    ref = _loss_of(_run_children(1, _free_port())[0])
-    outs = _run_children(2, _free_port())
+@pytest.mark.parametrize("mode", ["step", "ring"])
+def test_two_process_step_matches_single_process(mode):
+    """One training step across two REAL processes equals the single-process
+    run of the identical global batch. 'step' exercises the dense loss (XLA
+    psum/all-gather over gloo); 'ring' exercises the ring loss, whose rotating
+    ppermute is a different collective that only a multi-process run proves
+    gloo carries."""
+    ref = _loss_of(_run_children(1, _free_port(), mode=mode)[0])
+    outs = _run_children(2, _free_port(), mode=mode)
     losses = [_loss_of(o) for o in outs]
     # both processes compute the same replicated global loss...
     assert losses[0] == losses[1], losses
@@ -92,7 +99,7 @@ def test_two_process_step_matches_single_process():
     np.testing.assert_allclose(losses[0], ref, rtol=1e-6)
 
 
-def _run_driver_children(tmp_path, mode, extra_args=(), timeout=540):
+def _run_driver_children(tmp_path, mode, extra_args=(), timeout=900):
     env = _child_env()
     port = _free_port()
     procs = [
