@@ -1,15 +1,24 @@
-"""Reference-checkpoint interoperability: torch ``.pth`` -> orbax payload.
+"""Reference-checkpoint interoperability, BOTH directions:
+torch ``.pth`` <-> orbax payload.
 
 The reference saves ``{'opt', 'model', 'optimizer', 'epoch'}`` via ``torch.save``
 (``util.py:87-96``), where ``'model'`` is the DDP-wrapped ``SupConResNet``
 state_dict — every key carries a ``'module.'`` prefix that the probe strips on
 load (``main_linear.py:125-142``). This module converts that layout into this
 framework's orbax ``model`` payload (``{'params', 'batch_stats'}``) so a
-reference-pretrained encoder can be probed/warm-started here directly:
+reference-pretrained encoder can be probed/warm-started here directly, and
+exports this framework's checkpoints back into the reference's exact layout so
+encoders pretrained HERE can be consumed by the reference's probe or any torch
+tooling built around its checkpoints:
 
+    # import: reference .pth -> orbax dir usable as --ckpt
     python -m simclr_pytorch_distributed_tpu.utils.torch_convert \
         path/to/ckpt_epoch_100.pth out_dir/
     python main_linear.py --ckpt out_dir/ ...
+
+    # export: any checkpoint/run dir -> reference-format .pth
+    python -m simclr_pytorch_distributed_tpu.utils.torch_convert \
+        --export work_space/..._models/<run>/last out.pth
 
 Layout mapping (torch ``resnet_big.py`` -> ``models/``):
 
@@ -167,6 +176,147 @@ def torch_state_dict_to_variables(state_dict) -> dict:
     return {"params": params, "batch_stats": stats}
 
 
+def _inv_conv(w: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(np.transpose(w, (3, 2, 0, 1)))  # HWIO -> OIHW
+
+
+def variables_to_torch_state_dict(variables: dict) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`torch_state_dict_to_variables`: this framework's
+    ``{'params', 'batch_stats'}`` -> the reference ``SupConResNet`` state_dict
+    layout (``resnet_big.py:156-183``), as numpy arrays without the DDP
+    ``'module.'`` prefix. ``num_batches_tracked`` is emitted as 0 for every BN
+    (torch's fresh-module value; the reference's momentum=0.1 BNs never read
+    it) so ``load_state_dict(strict=True)`` sees a complete dict. Raises on
+    any tree node it cannot represent in the reference layout (e.g. the
+    ``--stem s2d`` repacked stem), so a lossy export cannot pass silently."""
+    params = variables["params"]
+    stats = variables.get("batch_stats", {})
+    sd: Dict[str, np.ndarray] = {}
+
+    def put(key: str, arr) -> None:
+        sd[key] = np.ascontiguousarray(np.asarray(arr, np.float32))
+
+    def put_bn(dst: str, p: dict, s: dict) -> None:
+        put(f"{dst}.weight", p["scale"])
+        put(f"{dst}.bias", p["bias"])
+        put(f"{dst}.running_mean", s["mean"])
+        put(f"{dst}.running_var", s["var"])
+        sd[f"{dst}.num_batches_tracked"] = np.asarray(0, np.int64)
+
+    def put_linear(dst: str, p: dict) -> None:
+        put(f"{dst}.weight", np.asarray(p["kernel"], np.float32).T)
+        put(f"{dst}.bias", p["bias"])
+
+    for name, sub in params["encoder"].items():
+        if name == "conv1":
+            put("encoder.conv1.weight", _inv_conv(sub["kernel"]))
+        elif name == "bn1":
+            put_bn("encoder.bn1", sub, stats["encoder"]["bn1"])
+        elif m := re.match(r"layer(\d)_block(\d+)$", name):
+            layer, block = m.groups()
+            src_stats = stats["encoder"][name]
+            for part, leaf in sub.items():
+                dst = f"encoder.layer{layer}.{block}"
+                if cm := re.match(r"Conv_(\d)$", part):
+                    put(f"{dst}.conv{int(cm.group(1)) + 1}.weight",
+                        _inv_conv(leaf["kernel"]))
+                elif re.match(r"bn\d$", part):
+                    put_bn(f"{dst}.{part}", leaf, src_stats[part])
+                elif part == "shortcut_conv":
+                    put(f"{dst}.shortcut.0.weight", _inv_conv(leaf["kernel"]))
+                elif part == "shortcut_bn":
+                    put_bn(f"{dst}.shortcut.1", leaf, src_stats[part])
+                else:
+                    raise ValueError(
+                        f"cannot express {name}/{part} in the reference layout"
+                    )
+        else:
+            raise ValueError(
+                f"cannot express encoder/{name} in the reference layout "
+                f"(e.g. '--stem s2d' checkpoints are not exportable)"
+            )
+
+    head = params["proj_head"]
+    if "fc1" in head:
+        put_linear("head.0", head["fc1"])
+        put_linear("head.2", head["fc2"])
+    elif "fc" in head:
+        put_linear("head", head["fc"])
+    else:
+        raise ValueError(f"unrecognized proj_head tree: {sorted(head)}")
+    return sd
+
+
+def export_reference_checkpoint(
+    ckpt_path: str, out_pth: str, epoch: "int | None" = None
+) -> dict:
+    """This framework's checkpoint -> a reference-format ``.pth``.
+
+    The exported file matches ``util.py:87-96``'s ``save_model`` layout —
+    ``{'opt', 'model' ('module.'-prefixed state_dict), 'optimizer', 'epoch'}``
+    — so the reference's own ``main_linear.py:125-142`` load path (and any
+    torch tooling built around its checkpoints) consumes it directly.
+    ``ckpt_path`` is a dir holding a ``model`` payload (ckpt_epoch_N / last /
+    a torch_convert output) or a run dir (resolved to its latest complete
+    checkpoint). Returns ``{'model_name', 'head', 'feat_dim', 'epoch',
+    'path'}``."""
+    import torch  # lazy: only conversion needs torch
+
+    import orbax.checkpoint as ocp
+
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        MODEL_LAYOUT_VERSION,
+        resolve_resume_path,
+    )
+
+    ckpt_path = os.path.abspath(ckpt_path)
+    if not os.path.isdir(os.path.join(ckpt_path, "model")):
+        ckpt_path = resolve_resume_path(ckpt_path)
+    meta_path = os.path.join(ckpt_path, "meta.json")
+    meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        saved_layout = meta.get("model_layout", 1)
+        if saved_layout != MODEL_LAYOUT_VERSION:
+            # torch's padding=1 convs match this build's v2 semantics only; a
+            # pre-v2 checkpoint would strict-load into the reference cleanly
+            # yet be silently wrong — refuse, per this module's contract.
+            raise ValueError(
+                f"{ckpt_path} was saved at model layout v{saved_layout} but "
+                f"the reference's conv semantics require v{MODEL_LAYOUT_VERSION}"
+                f"; re-train or re-save under the current layout before export"
+            )
+    if epoch is None:
+        epoch = meta.get("epoch")
+
+    ckptr = ocp.StandardCheckpointer()
+    variables = ckptr.restore(os.path.join(ckpt_path, "model"))
+    ckptr.close()
+    sd_np = variables_to_torch_state_dict(variables)
+    sd = {f"module.{k}": torch.from_numpy(v) for k, v in sd_np.items()}
+    model_name, head, feat_dim = infer_architecture(sd_np)
+    payload = {
+        # the reference stores its argparse Namespace here; a plain dict keeps
+        # the slot readable without importing anything of ours
+        "opt": {
+            "model": model_name, "head": head, "feat_dim": feat_dim,
+            "exported_from": ckpt_path,
+            "config": meta.get("config", {}),
+        },
+        "model": sd,
+        "optimizer": {},  # reference stores SGD state; not transferable
+        "epoch": int(epoch) if epoch is not None else 0,
+    }
+    out_pth = os.path.abspath(out_pth)
+    os.makedirs(os.path.dirname(out_pth) or ".", exist_ok=True)
+    torch.save(payload, out_pth)
+    return {
+        "model_name": model_name, "head": head, "feat_dim": feat_dim,
+        "epoch": epoch, "path": out_pth,
+    }
+
+
 def convert_reference_checkpoint(pth_path: str, out_dir: str) -> dict:
     """Load a reference ``.pth`` and write this framework's orbax payload.
 
@@ -211,12 +361,23 @@ def main(argv=None):
     import argparse
 
     p = argparse.ArgumentParser(
-        "convert a reference torch .pth checkpoint to an orbax model payload"
+        description="convert checkpoints between the reference's torch .pth "
+                    "layout and this framework's orbax payload (both "
+                    "directions)"
     )
-    p.add_argument("pth", help="reference checkpoint (util.py:87-96 layout)")
-    p.add_argument("out_dir", help="output dir, usable as --ckpt")
+    p.add_argument("src", help="reference .pth (import) or checkpoint/run dir "
+                               "(--export)")
+    p.add_argument("dst", help="output dir usable as --ckpt (import) or "
+                               "output .pth path (--export)")
+    p.add_argument(
+        "--export", action="store_true",
+        help="reverse direction: orbax checkpoint -> reference-format .pth",
+    )
     args = p.parse_args(argv)
-    info = convert_reference_checkpoint(args.pth, args.out_dir)
+    if args.export:
+        info = export_reference_checkpoint(args.src, args.dst)
+    else:
+        info = convert_reference_checkpoint(args.src, args.dst)
     print(json.dumps(info))
 
 

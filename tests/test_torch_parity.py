@@ -455,6 +455,105 @@ def test_reference_checkpoint_converts_and_loads(ref_resnet_big, tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_export_roundtrip_reproduces_reference_state_dict(ref_resnet_big):
+    """variables_to_torch_state_dict is the exact inverse of the import
+    mapping: torch state_dict -> variables -> state_dict is the identity
+    (keys AND values), so nothing is lost in a pth -> orbax -> pth trip."""
+    from simclr_pytorch_distributed_tpu.utils.torch_convert import (
+        variables_to_torch_state_dict,
+    )
+
+    torch.manual_seed(11)
+    tm = ref_resnet_big.SupConResNet(name="resnet18")
+    tm.train()
+    with torch.no_grad():
+        tm(torch.randn(8, 3, 32, 32))
+    tm.eval()
+    sd = {k: v.numpy() for k, v in tm.state_dict().items()}
+
+    back = variables_to_torch_state_dict(torch_state_dict_to_variables(sd))
+    assert set(back) == set(sd)
+    for k in sd:
+        if k.endswith("num_batches_tracked"):
+            continue  # synthesized as 0 on export; torch never reads it
+        np.testing.assert_allclose(back[k], sd[k], rtol=1e-6, atol=0, err_msg=k)
+
+
+def test_export_consumed_by_reference_strict_load(ref_resnet_big, tmp_path):
+    """An encoder pretrained HERE exports to a .pth the reference itself can
+    consume: torch.load -> 'module.' strip -> load_state_dict(strict=True)
+    into the reference SupConResNet -> forward parity with the Flax model."""
+    from simclr_pytorch_distributed_tpu.models import SupConResNet
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        MODEL_LAYOUT_VERSION,
+        _save_tree,
+        _write_meta,
+    )
+    from simclr_pytorch_distributed_tpu.utils.torch_convert import (
+        export_reference_checkpoint,
+    )
+
+    fm = SupConResNet(model_name="resnet18")
+    variables = fm.init(jax.random.key(5), jnp.zeros((2, 32, 32, 3)))
+    ckpt = tmp_path / "ckpt_epoch_9"
+    _save_tree(str(ckpt / "model"), jax.tree.map(np.asarray, dict(variables)))
+    _write_meta(str(ckpt), {"epoch": 9, "model_layout": MODEL_LAYOUT_VERSION,
+                            "config": {"model": "resnet18"}})
+
+    # a pre-v2 (shifted conv padding) checkpoint must refuse to export: it
+    # would strict-load into the reference cleanly yet be silently wrong
+    stale = tmp_path / "stale"
+    _save_tree(str(stale / "model"), jax.tree.map(np.asarray, dict(variables)))
+    _write_meta(str(stale), {"epoch": 1})  # no model_layout -> v1
+    with pytest.raises(ValueError, match="layout v1"):
+        export_reference_checkpoint(str(stale), str(tmp_path / "stale.pth"))
+
+    out_pth = tmp_path / "exported.pth"
+    info = export_reference_checkpoint(str(ckpt), str(out_pth))
+    assert (info["model_name"], info["head"], info["feat_dim"]) == (
+        "resnet18", "mlp", 128,
+    )
+    assert info["epoch"] == 9
+
+    payload = torch.load(str(out_pth), map_location="cpu", weights_only=False)
+    assert set(payload) == {"opt", "model", "optimizer", "epoch"}
+    assert payload["epoch"] == 9
+    assert all(k.startswith("module.") for k in payload["model"])
+
+    tm = ref_resnet_big.SupConResNet(name="resnet18")
+    tm.load_state_dict(
+        {k[len("module."):]: v for k, v in payload["model"].items()},
+        strict=True,
+    )
+    tm.eval()
+
+    x = np.random.default_rng(6).normal(size=(4, 3, 32, 32)).astype(np.float32)
+    with torch.no_grad():
+        feat_t = tm.encoder(torch.tensor(x)).numpy()
+        out_t = tm(torch.tensor(x)).numpy()
+    x_nhwc = jnp.asarray(np.transpose(x, (0, 2, 3, 1)))
+    feat_j = fm.apply(variables, x_nhwc, train=False, method=SupConResNet.encode)
+    out_j = fm.apply(variables, x_nhwc, train=False)
+    np.testing.assert_allclose(np.asarray(feat_j), feat_t, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out_j), out_t, rtol=1e-3, atol=1e-4)
+
+
+def test_export_rejects_s2d_stem():
+    """The repacked '--stem s2d' layout has no reference equivalent; export
+    must fail loudly rather than write a silently-wrong .pth."""
+    from simclr_pytorch_distributed_tpu.models import SupConResNet
+    from simclr_pytorch_distributed_tpu.utils.torch_convert import (
+        variables_to_torch_state_dict,
+    )
+
+    fm = SupConResNet(model_name="resnet18", stem="s2d")
+    variables = fm.init(jax.random.key(7), jnp.zeros((2, 32, 32, 3)))
+    with pytest.raises(ValueError, match="s2d"):
+        variables_to_torch_state_dict(
+            jax.tree.map(np.asarray, dict(variables))
+        )
+
+
 def test_topk_accuracy_matches_reference(ref_util):
     """ops.metrics.topk_accuracy vs the reference's accuracy() (util.py:37-51).
 
