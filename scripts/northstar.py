@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""The north-star experiment, fully automated: CIFAR-10 SimCLR ResNet-50
+pretrain (100 and/or 200 epochs) + linear probe vs the reference's published
+numbers (84.76 / 89.05% top-1, ``/root/reference/README.md:44-45``;
+BASELINE.md).
+
+The moment real data is reachable this is ONE command with zero decisions
+left:
+
+    python scripts/northstar.py                      # both points
+    python scripts/northstar.py --points 200         # just the headline
+    python scripts/northstar.py --dry-run            # plumbing check, no data
+
+It (a) fetches CIFAR-10 if absent and egress exists (urllib + md5, the
+reference's torchvision download=True parity — data/cifar.py download_cifar),
+(b) runs the exact run_supcon.sh / run_linear.sh recipe per point, (c) prints
+one JSON line per point comparing top-1 against the published value +-0.5
+(the BASELINE.md north-star tolerance) and exits nonzero if any point misses.
+
+``--dry-run`` swaps in synthetic_hard32 at 2 epochs to validate the entire
+pipeline (pretrain subprocess -> run-dir resolution -> probe subprocess ->
+accuracy parse -> JSON) with no dataset and no egress.
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# published reference points: epochs -> (top1, top5)  (README.md:44-45)
+PUBLISHED = {100: (84.76, 99.36), 200: (89.05, 99.69)}
+TOLERANCE = 0.5  # BASELINE.md north star: within +-0.5 of 89.05
+
+
+def run(cmd, log_path):
+    with open(log_path, "w") as f:
+        proc = subprocess.run(cmd, cwd=REPO, stdout=f, stderr=subprocess.STDOUT)
+    if proc.returncode != 0:
+        sys.exit(f"FAILED ({proc.returncode}): {' '.join(cmd)}; see {log_path}")
+
+
+def parse_probe_log(log_path):
+    """(top1, top5) from the probe driver's 'best accuracy' line."""
+    best = None
+    with open(log_path) as f:
+        for line in f:
+            m = re.search(r"best accuracy: ([0-9.]+), accuracy5: ([0-9.]+)", line)
+            if m:
+                best = (float(m.group(1)), float(m.group(2)))
+            else:
+                m1 = re.search(r"best accuracy: ([0-9.]+)", line)
+                if m1:
+                    best = (float(m1.group(1)), None)
+    if best is None:
+        sys.exit(f"no 'best accuracy' line in {log_path}")
+    return best
+
+
+def newest_run_dir(workdir, dataset, suffix):
+    models = os.path.join(workdir, f"{dataset}_models")
+    runs = [
+        os.path.join(models, d)
+        for d in os.listdir(models)
+        if d.endswith(suffix)
+    ]
+    if not runs:
+        sys.exit(f"no run dir matching *{suffix} in {models}")
+    return max(runs, key=os.path.getmtime)
+
+
+def run_point(epochs, args):
+    """Pretrain + probe one north-star point; returns the result record."""
+    dataset = "synthetic_hard32" if args.dry_run else "cifar10"
+    trial = f"{args.trial}_{epochs}ep"
+    pre_epochs = 2 if args.dry_run else epochs
+    probe_epochs = 2 if args.dry_run else 100  # reference probe default
+    logs = os.path.join(args.workdir, f"northstar_{trial}")
+    os.makedirs(logs, exist_ok=True)
+
+    # the exact run_supcon.sh recipe (reference 2-GPU launch; --ngpu 2 keeps
+    # the DDP gradient scale): SyncBN, bsz 256, lr 0.5, temp 0.5, cosine
+    pre_log = os.path.join(logs, "pretrain.log")
+    run(
+        [sys.executable, "main_supcon.py", "--dataset", dataset,
+         "--data_folder", args.data_folder,
+         "--syncBN", "--epochs", str(pre_epochs), "--batch_size", "256",
+         "--learning_rate", "0.5", "--temp", "0.5", "--cosine",
+         "--method", "SimCLR", "--ngpu", "2",
+         "--save_freq", str(pre_epochs), "--print_freq", "20",
+         "--workdir", args.workdir, "--seed", str(args.seed),
+         "--trial", trial]
+        + (["--no_download"] if args.no_download else []),
+        pre_log,
+    )
+    run_dir = newest_run_dir(args.workdir, dataset, f"trial_{trial}_cosine")
+
+    # the exact run_linear.sh recipe: lr 5, bsz 256
+    probe_log = os.path.join(logs, "probe.log")
+    run(
+        [sys.executable, "main_linear.py", "--dataset", dataset,
+         "--data_folder", args.data_folder,
+         "--epochs", str(probe_epochs), "--learning_rate", "5",
+         "--batch_size", "256", "--ckpt", os.path.join(run_dir, "last"),
+         "--workdir", args.workdir, "--trial", trial]
+        + (["--no_download"] if args.no_download else []),
+        probe_log,
+    )
+    top1, top5 = parse_probe_log(probe_log)
+
+    pub1, pub5 = PUBLISHED[epochs]
+    record = {
+        "metric": f"northstar_cifar10_probe_top1_{epochs}ep",
+        "value": top1, "top5": top5,
+        "published_top1": pub1, "published_top5": pub5,
+        "tolerance": TOLERANCE,
+        "delta": round(top1 - pub1, 4),
+        "ok": top1 >= pub1 - TOLERANCE,
+        "dry_run": args.dry_run,
+        "pretrain_log": pre_log, "probe_log": probe_log,
+        "run_dir": run_dir,
+    }
+    if args.dry_run:
+        # a 2-epoch synthetic run proves the plumbing, not the number
+        record["ok"] = top1 > 0.0
+    return record
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", type=int, nargs="+", default=[100, 200],
+                    choices=sorted(PUBLISHED))
+    ap.add_argument("--workdir", default=os.path.join(REPO, "work_space"))
+    ap.add_argument("--data_folder", default=os.path.join(REPO, "datasets"))
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--trial", default="northstar")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="synthetic_hard32 at 2 epochs: validate the pipeline")
+    ap.add_argument("--no_download", action="store_true")
+    args = ap.parse_args()
+
+    if not args.dry_run and not args.no_download:
+        # fetch up front so a missing-egress failure is loud and immediate
+        from simclr_pytorch_distributed_tpu.data.cifar import maybe_download
+
+        maybe_download("cifar10", args.data_folder)
+        marker = os.path.join(args.data_folder, "cifar-10-batches-py")
+        if not os.path.isdir(marker):
+            sys.exit(
+                f"CIFAR-10 not at {marker} and download failed (no egress?) "
+                "— place the python-version binaries there and re-run"
+            )
+
+    ok = True
+    for epochs in args.points:
+        record = run_point(epochs, args)
+        print(json.dumps(record), flush=True)
+        ok = ok and record["ok"]
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -75,6 +75,9 @@ class SupConConfig:
     head: str = "mlp"
     feat_dim: int = 128
     # --- TPU-native additions ---
+    # fetch CIFAR if absent (the reference's torchvision download=True,
+    # main_supcon.py:181-188); process-0-gated in the drivers
+    download: bool = True
     bf16: bool = False
     resume: str = ""
     model_parallel: int = 1
@@ -144,6 +147,8 @@ def supcon_parser() -> argparse.ArgumentParser:
                    help="mean of dataset in path in form of str tuple")
     p.add_argument("--std", type=str, default=None)
     p.add_argument("--data_folder", type=str, default=None)
+    p.add_argument("--no_download", dest="download", action="store_false",
+                   default=True, help="never fetch CIFAR over the network")
     p.add_argument("--size", type=int, default=d.size)
     p.add_argument("--store_size", type=int, default=d.store_size,
                    help="path datasets: stored resolution (0 = 2*size)")
@@ -248,6 +253,11 @@ class LinearConfig:
     dataset: str = "cifar10"  # {cifar10, cifar100, synthetic, synthetic_hard, synthetic_hard32}
     cosine: bool = False
     warm: bool = False
+    # CE trainer only: per-device vs synchronized BN, same conditional the
+    # reference's pretrain applies (main_supcon.py:223-224); default off =
+    # per-device statistics. The probe ignores it (frozen eval-mode encoder).
+    syncBN: bool = False
+    download: bool = True  # fetch CIFAR if absent (torchvision parity)
     ckpt: str = ""
     # TPU-native additions
     data_folder: str = "./datasets/"
@@ -286,10 +296,14 @@ def linear_parser(ce: bool = False) -> argparse.ArgumentParser:
                    choices=["cifar10", "cifar100", "synthetic", "synthetic_hard", "synthetic_hard32"])
     _add_bool_flag(p, "cosine")
     _add_bool_flag(p, "warm")
+    if ce:
+        _add_bool_flag(p, "syncBN")
     if not ce:
         p.add_argument("--ckpt", type=str, default=d.ckpt,
                        help="path to pre-trained model checkpoint dir")
     p.add_argument("--data_folder", type=str, default=d.data_folder)
+    p.add_argument("--no_download", dest="download", action="store_false",
+                   default=True, help="never fetch CIFAR over the network")
     p.add_argument("--val_batch_size", type=int, default=d.val_batch_size)
     _add_bool_flag(p, "bf16")
     p.add_argument("--seed", type=int, default=d.seed)

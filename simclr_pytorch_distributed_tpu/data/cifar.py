@@ -15,13 +15,99 @@ Arrays come back HWC uint8 — augmentation converts to float on device
 
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
-from typing import Dict, Tuple
+import shutil
+import tarfile
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 NumpyDataset = Dict[str, np.ndarray]  # images [N,32,32,3] u8, labels [N] i32
+
+# The canonical archives torchvision fetches (the reference's download=True,
+# main_supcon.py:181-188): (archive name, md5, extracted marker dir).
+CIFAR_ARCHIVES = {
+    "cifar10": (
+        "cifar-10-python.tar.gz",
+        "c58f30108f718f92721af3b95e74349a",
+        "cifar-10-batches-py",
+    ),
+    "cifar100": (
+        "cifar-100-python.tar.gz",
+        "eb9058c3a382ffc7106e4002c42a8d85",
+        "cifar-100-python",
+    ),
+}
+CIFAR_BASE_URL = "https://www.cs.toronto.edu/~kriz"
+
+
+def _md5(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download_cifar(
+    dataset: str,
+    data_folder: str,
+    base_url: Optional[str] = None,
+    md5: Optional[str] = None,
+    timeout: float = 60.0,
+) -> str:
+    """Fetch + verify + extract a CIFAR archive; returns the marker dir.
+
+    torchvision-download parity for environments WITH egress (the reference
+    bootstraps its own data, ``main_supcon.py:181-188``; this framework
+    otherwise requires pre-placed binaries). Idempotent: an already-extracted
+    marker dir or an already-downloaded md5-verified archive short-circuits.
+    ``base_url``/``md5`` exist so tests can point at a local HTTP server.
+    """
+    import urllib.request
+
+    if dataset not in CIFAR_ARCHIVES:
+        raise ValueError(f"no download recipe for dataset {dataset!r}")
+    fname, want_md5, marker = CIFAR_ARCHIVES[dataset]
+    want_md5 = md5 or want_md5
+    root = os.path.abspath(data_folder)
+    os.makedirs(root, exist_ok=True)
+    marker_dir = os.path.join(root, marker)
+    if os.path.isdir(marker_dir):
+        return marker_dir
+
+    archive = os.path.join(root, fname)
+    if not (os.path.exists(archive) and _md5(archive) == want_md5):
+        url = f"{base_url or CIFAR_BASE_URL}/{fname}"
+        tmp = archive + ".partial"
+        with urllib.request.urlopen(url, timeout=timeout) as r, open(tmp, "wb") as f:
+            shutil.copyfileobj(r, f)
+        got = _md5(tmp)
+        if got != want_md5:
+            os.remove(tmp)
+            raise ValueError(f"md5 mismatch for {url}: got {got}, want {want_md5}")
+        os.replace(tmp, archive)  # atomic: no torn archive on the hit path
+
+    with tarfile.open(archive, "r:gz") as tar:
+        try:
+            # 'data' filter: refuse absolute paths / parent traversal / links
+            tar.extractall(root, filter="data")
+        except TypeError:  # Python < 3.10.12 predates the filter kwarg
+            base = os.path.realpath(root)
+            for m in tar.getmembers():
+                target = os.path.realpath(os.path.join(root, m.name))
+                if not target.startswith(base + os.sep):
+                    raise ValueError(f"unsafe tar member path: {m.name}")
+                if m.islnk() or m.issym():
+                    raise ValueError(f"refusing tar link member: {m.name}")
+            tar.extractall(root)
+    if not os.path.isdir(marker_dir):
+        raise FileNotFoundError(
+            f"{fname} extracted but {marker} did not appear under {root}"
+        )
+    return marker_dir
 
 
 def _decode_rows(data: np.ndarray) -> np.ndarray:
@@ -128,6 +214,66 @@ def synthetic_texture_dataset(
     train = {"images": images[k:], "labels": labels[k:]}
     test = {"images": images[:k], "labels": labels[:k]}
     return train, test
+
+
+def maybe_download(dataset: str, data_folder: Optional[str]) -> None:
+    """Best-effort CIFAR fetch when the on-disk binaries are absent.
+
+    The drivers call this on process 0 only (then barrier) so a multi-host
+    launch downloads once; failures degrade to load_dataset's pre-placed-
+    binaries error path with a warning.
+    """
+    import logging
+
+    if dataset not in CIFAR_ARCHIVES or not data_folder:
+        return
+    marker = CIFAR_ARCHIVES[dataset][2]
+    if os.path.isdir(os.path.join(data_folder, marker)):
+        return
+    try:
+        download_cifar(dataset, data_folder)
+        logging.info("downloaded %s into %s", dataset, data_folder)
+    except Exception as e:  # noqa: BLE001 — URLError/timeout/md5/...
+        logging.warning("could not download %s: %s", dataset, e)
+
+
+def ensure_dataset_available(
+    dataset: str, data_folder: Optional[str], download: bool = True
+) -> None:
+    """Download-if-absent with per-filesystem locking + cross-process barrier.
+
+    Drivers call this before ``load_dataset``. Gating on the global process 0
+    would strand hosts with their own local ``data_folder`` (the normal pod-VM
+    layout), so instead EVERY process races on an ``O_EXCL`` lock file in the
+    data folder itself: exactly one downloader per filesystem, co-located
+    processes wait for the lock to clear, and a final barrier keeps the
+    multi-host launch in step. A stale lock (crashed downloader) times out
+    and the waiter retries the download itself.
+    """
+    if not download or dataset not in CIFAR_ARCHIVES or not data_folder:
+        return
+    import time
+
+    from simclr_pytorch_distributed_tpu.parallel.mesh import sync_processes
+
+    marker = os.path.join(data_folder, CIFAR_ARCHIVES[dataset][2])
+    if not os.path.isdir(marker):
+        os.makedirs(data_folder, exist_ok=True)
+        lock = os.path.join(data_folder, f".{dataset}.download.lock")
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            deadline = time.time() + 1800
+            while os.path.exists(lock) and time.time() < deadline:
+                time.sleep(2)
+            maybe_download(dataset, data_folder)  # no-op if the peer finished
+        else:
+            try:
+                maybe_download(dataset, data_folder)
+            finally:
+                os.close(fd)
+                os.unlink(lock)
+    sync_processes("dataset_ready")
 
 
 def load_dataset(

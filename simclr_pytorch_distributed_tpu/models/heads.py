@@ -109,15 +109,22 @@ class SupCEResNet(nn.Module):
     num_classes: int = 10
     dtype: Any = jnp.float32
     axis_name: Optional[str] = None
-    # always-global BN: the reference's CE entry (main_ce.py, a 68-line stub
-    # after the fork) has no --syncBN flag or DDP wrap, so there is no
-    # per-device-BN semantic to reproduce on this path
+    # The reference's surviving CE entry (main_ce.py, a 68-line stub after the
+    # fork) never trains, but the trainer it lost carried the same conditional
+    # SyncBN conversion as main_supcon.py:223-224 — so the CE path gets the
+    # same semantics: sync_bn=True for global-batch statistics, or grouped
+    # per-device statistics (models/norm.py) with bn_local_groups = the
+    # data-parallel degree. CE batches are single-view: bn_group_views=1.
     sync_bn: bool = True
+    bn_local_groups: int = 1
+    bn_group_views: int = 1
 
     def setup(self):
         model_fn, _ = MODEL_DICT[self.model_name]
         self.encoder = model_fn(
-            dtype=self.dtype, axis_name=self.axis_name, sync_bn=self.sync_bn
+            dtype=self.dtype, axis_name=self.axis_name, sync_bn=self.sync_bn,
+            bn_local_groups=self.bn_local_groups,
+            bn_group_views=self.bn_group_views,
         )
         self.fc = TorchDense(self.num_classes, dtype=jnp.float32)
 

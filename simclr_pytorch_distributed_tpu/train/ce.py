@@ -20,7 +20,10 @@ import optax
 from flax import struct
 
 from simclr_pytorch_distributed_tpu import config as config_lib
-from simclr_pytorch_distributed_tpu.data.cifar import load_dataset
+from simclr_pytorch_distributed_tpu.data.cifar import (
+    ensure_dataset_available,
+    load_dataset,
+)
 from simclr_pytorch_distributed_tpu.data.pipeline import EpochLoader
 from simclr_pytorch_distributed_tpu.models import SupCEResNet
 from simclr_pytorch_distributed_tpu.ops.augment import (
@@ -121,6 +124,7 @@ def run(cfg: config_lib.LinearConfig):
     setup_logging(cfg.save_folder, is_main_process())
     mesh = create_mesh()
 
+    ensure_dataset_available(cfg.dataset, cfg.data_folder, cfg.download)
     train_data, test_data, n_cls = load_dataset(
         cfg.dataset, cfg.data_folder,
         allow_synthetic_fallback=(cfg.dataset == "synthetic"),
@@ -134,7 +138,14 @@ def run(cfg: config_lib.LinearConfig):
     steps_per_epoch = len(loader)
 
     dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
-    model = SupCEResNet(model_name=cfg.model, num_classes=n_cls, dtype=dtype)
+    # --syncBN off (default) = the reference's per-GPU BatchNorm2d semantics:
+    # BN statistics scoped to the data-parallel device slices (models/norm.py
+    # grouped mode; conversion is conditional upstream, main_supcon.py:223-224)
+    model = SupCEResNet(
+        model_name=cfg.model, num_classes=n_cls, dtype=dtype,
+        sync_bn=cfg.syncBN,
+        bn_local_groups=1 if cfg.syncBN else mesh.shape["data"],
+    )
     schedule = make_lr_schedule(
         learning_rate=cfg.learning_rate, epochs=cfg.epochs,
         steps_per_epoch=steps_per_epoch, cosine=cfg.cosine,

@@ -31,7 +31,10 @@ import optax
 from flax import struct
 
 from simclr_pytorch_distributed_tpu import config as config_lib
-from simclr_pytorch_distributed_tpu.data.cifar import load_dataset
+from simclr_pytorch_distributed_tpu.data.cifar import (
+    ensure_dataset_available,
+    load_dataset,
+)
 from simclr_pytorch_distributed_tpu.data.pipeline import EpochLoader
 from simclr_pytorch_distributed_tpu.models import (
     MODEL_DICT,
@@ -200,6 +203,7 @@ def run(cfg: config_lib.LinearConfig):
     setup_logging(cfg.save_folder, is_main_process())
     mesh = create_mesh()
 
+    ensure_dataset_available(cfg.dataset, cfg.data_folder, cfg.download)
     train_data, test_data, n_cls = load_dataset(
         cfg.dataset, cfg.data_folder,
         allow_synthetic_fallback=(cfg.dataset == "synthetic"),

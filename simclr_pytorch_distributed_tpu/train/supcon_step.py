@@ -39,7 +39,10 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from simclr_pytorch_distributed_tpu.ops.losses import supcon_loss
-from simclr_pytorch_distributed_tpu.ops.pallas_loss import fused_supcon_loss
+from simclr_pytorch_distributed_tpu.ops.pallas_loss import (
+    fused_sharded_supcon_loss,
+    fused_supcon_loss,
+)
 from simclr_pytorch_distributed_tpu.parallel.collectives import ring_supcon_loss
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -114,14 +117,14 @@ def make_train_step(
     """
     if cfg.loss_impl == "ring" and mesh is None:
         raise ValueError("loss_impl='ring' needs the mesh passed to make_train_step")
-    if cfg.loss_impl == "fused" and mesh is not None and mesh.size > 1:
-        # the pallas_call has no partitioning rule: GSPMD would all-gather the
-        # features and run the kernel fully replicated on every device,
-        # silently losing the scaling the 'auto' heuristic avoids
-        raise ValueError(
-            "loss_impl='fused' is single-device only; on a multi-device mesh "
-            "use 'dense' (GSPMD-partitioned) or 'ring'"
-        )
+    # 'fused' on a multi-device mesh routes through the shard_map-sharded
+    # kernel (ops/pallas_loss.py fused_sharded_supcon_loss): anchors stay
+    # sharded over 'data', the contrast side is all-gathered, and the logits
+    # tiles never leave VMEM. A bare pallas_call has no GSPMD partitioning
+    # rule, so without this the kernel would run fully replicated.
+    fused_on_mesh = (
+        cfg.loss_impl == "fused" and mesh is not None and mesh.size > 1
+    )
 
     def loss_fn(params, state: TrainState, images, labels):
         feats, new_batch_stats = two_view_forward(
@@ -179,6 +182,30 @@ def make_train_step(
                 contrastive = shard_map(
                     _ring, mesh=mesh,
                     in_specs=(P(DATA_AXIS), P()), out_specs=P(),
+                )(n_fea, loss_labels)
+        elif fused_on_mesh:
+            # same row layout and shard_map plumbing as the ring path; the
+            # kernel needs check_vma=False (interpret-mode Pallas cannot type
+            # kernel-internal constants) — its custom VJP compensates for the
+            # per-shard cotangent shares (ops/pallas_loss.py).
+            def _fs(rows, lab):
+                return fused_sharded_supcon_loss(
+                    rows, lab, axis_name=DATA_AXIS,
+                    temperature=cfg.temperature,
+                    base_temperature=cfg.base_temperature, n_views=2,
+                    interpret=jax.default_backend() != "tpu",
+                )
+
+            if loss_labels is None:
+                contrastive = shard_map(
+                    lambda r: _fs(r, None), mesh=mesh,
+                    in_specs=P(DATA_AXIS), out_specs=P(), check_vma=False,
+                )(n_fea)
+            else:
+                contrastive = shard_map(
+                    _fs, mesh=mesh,
+                    in_specs=(P(DATA_AXIS), P()), out_specs=P(),
+                    check_vma=False,
                 )(n_fea, loss_labels)
         elif cfg.loss_impl == "fused":
             contrastive = fused_supcon_loss(

@@ -180,6 +180,34 @@ def _inv_conv(w: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(np.transpose(w, (3, 2, 0, 1)))  # HWIO -> OIHW
 
 
+# models the reference can actually construct (resnet_big.py model_dict);
+# exports of framework-only extensions (resnet10) would produce a .pth the
+# reference cannot consume, so export refuses them.
+_REFERENCE_MODELS = frozenset({"resnet18", "resnet34", "resnet50", "resnet101"})
+
+
+def _bn_stats(stats: dict, path: Tuple[str, ...]) -> dict:
+    """Resolve one BN's ``batch_stats`` node, raising ValueError (this
+    module's stated error contract) naming the missing node instead of a bare
+    KeyError from deep indexing."""
+    node = stats
+    for p in path:
+        if not isinstance(node, dict) or p not in node:
+            raise ValueError(
+                "variables tree is missing batch_stats for BN node "
+                f"'{'/'.join(path)}' — cannot express it in the reference "
+                "layout (was the checkpoint saved without batch_stats?)"
+            )
+        node = node[p]
+    for leaf in ("mean", "var"):
+        if leaf not in node:
+            raise ValueError(
+                f"batch_stats node '{'/'.join(path)}' has no '{leaf}' — "
+                "cannot express it in the reference layout"
+            )
+    return node
+
+
 def variables_to_torch_state_dict(variables: dict) -> Dict[str, np.ndarray]:
     """Inverse of :func:`torch_state_dict_to_variables`: this framework's
     ``{'params', 'batch_stats'}`` -> the reference ``SupConResNet`` state_dict
@@ -196,7 +224,8 @@ def variables_to_torch_state_dict(variables: dict) -> Dict[str, np.ndarray]:
     def put(key: str, arr) -> None:
         sd[key] = np.ascontiguousarray(np.asarray(arr, np.float32))
 
-    def put_bn(dst: str, p: dict, s: dict) -> None:
+    def put_bn(dst: str, p: dict, stats_path: Tuple[str, ...]) -> None:
+        s = _bn_stats(stats, stats_path)
         put(f"{dst}.weight", p["scale"])
         put(f"{dst}.bias", p["bias"])
         put(f"{dst}.running_mean", s["mean"])
@@ -211,21 +240,20 @@ def variables_to_torch_state_dict(variables: dict) -> Dict[str, np.ndarray]:
         if name == "conv1":
             put("encoder.conv1.weight", _inv_conv(sub["kernel"]))
         elif name == "bn1":
-            put_bn("encoder.bn1", sub, stats["encoder"]["bn1"])
+            put_bn("encoder.bn1", sub, ("encoder", "bn1"))
         elif m := re.match(r"layer(\d)_block(\d+)$", name):
             layer, block = m.groups()
-            src_stats = stats["encoder"][name]
             for part, leaf in sub.items():
                 dst = f"encoder.layer{layer}.{block}"
                 if cm := re.match(r"Conv_(\d)$", part):
                     put(f"{dst}.conv{int(cm.group(1)) + 1}.weight",
                         _inv_conv(leaf["kernel"]))
                 elif re.match(r"bn\d$", part):
-                    put_bn(f"{dst}.{part}", leaf, src_stats[part])
+                    put_bn(f"{dst}.{part}", leaf, ("encoder", name, part))
                 elif part == "shortcut_conv":
                     put(f"{dst}.shortcut.0.weight", _inv_conv(leaf["kernel"]))
                 elif part == "shortcut_bn":
-                    put_bn(f"{dst}.shortcut.1", leaf, src_stats[part])
+                    put_bn(f"{dst}.shortcut.1", leaf, ("encoder", name, part))
                 else:
                     raise ValueError(
                         f"cannot express {name}/{part} in the reference layout"
@@ -248,7 +276,8 @@ def variables_to_torch_state_dict(variables: dict) -> Dict[str, np.ndarray]:
 
 
 def export_reference_checkpoint(
-    ckpt_path: str, out_pth: str, epoch: "int | None" = None
+    ckpt_path: str, out_pth: str, epoch: "int | None" = None,
+    allow_missing_meta: bool = False,
 ) -> dict:
     """This framework's checkpoint -> a reference-format ``.pth``.
 
@@ -274,7 +303,18 @@ def export_reference_checkpoint(
         ckpt_path = resolve_resume_path(ckpt_path)
     meta_path = os.path.join(ckpt_path, "meta.json")
     meta = {}
-    if os.path.exists(meta_path):
+    if not os.path.exists(meta_path):
+        # meta.json is both the save-completeness marker (utils/checkpoint.py
+        # stamps it atomically after the payload) and the only carrier of
+        # model_layout; exporting without it would skip the layout guard
+        # below — the 'lossy export cannot pass silently' contract.
+        if not allow_missing_meta:
+            raise ValueError(
+                f"{ckpt_path} has no meta.json — the checkpoint may be an "
+                "incomplete save, and its model layout cannot be verified; "
+                "pass --allow-missing-meta to export anyway"
+            )
+    else:
         with open(meta_path) as f:
             meta = json.load(f)
         saved_layout = meta.get("model_layout", 1)
@@ -296,6 +336,15 @@ def export_reference_checkpoint(
     sd_np = variables_to_torch_state_dict(variables)
     sd = {f"module.{k}": torch.from_numpy(v) for k, v in sd_np.items()}
     model_name, head, feat_dim = infer_architecture(sd_np)
+    if model_name not in _REFERENCE_MODELS:
+        # e.g. resnet10: opt.model would name an architecture absent from the
+        # reference's model_dict (resnet_big.py:121-142) — the .pth would
+        # export "successfully" yet be unconsumable upstream.
+        raise ValueError(
+            f"'{model_name}' is a framework-only extension with no entry in "
+            "the reference's model_dict — the exported .pth could not be "
+            "loaded by the reference"
+        )
     payload = {
         # the reference stores its argparse Namespace here; a plain dict keeps
         # the slot readable without importing anything of ours
@@ -373,9 +422,17 @@ def main(argv=None):
         "--export", action="store_true",
         help="reverse direction: orbax checkpoint -> reference-format .pth",
     )
+    p.add_argument(
+        "--allow-missing-meta", action="store_true",
+        help="export even when the checkpoint dir has no meta.json "
+             "(completeness marker + model-layout carrier); epoch defaults "
+             "to 0 and the layout guard is skipped",
+    )
     args = p.parse_args(argv)
     if args.export:
-        info = export_reference_checkpoint(args.src, args.dst)
+        info = export_reference_checkpoint(
+            args.src, args.dst, allow_missing_meta=args.allow_missing_meta
+        )
     else:
         info = convert_reference_checkpoint(args.src, args.dst)
     print(json.dumps(info))

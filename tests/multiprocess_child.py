@@ -5,10 +5,13 @@ parallel/mesh.py + data/pipeline.py).
 Usage: python multiprocess_child.py <process_id> <num_processes> <port> [mode]
 
 With num_processes > 1 it joins a gloo-backed jax.distributed cluster (each
-process contributing its single CPU device) and prints the first training
-step's loss; with num_processes == 1 it computes the same GLOBAL step alone
-(two virtual CPU devices) as the reference value. The parent asserts all
-printed losses match.
+process contributing CHILD_LOCAL_DEVICES virtual CPU devices — default 1, the
+original one-device-per-process topology; 2 models a real pod host with
+multiple local chips, where host-batch slicing, ring ppermute, and collective
+saves cross BOTH the process and the local-device boundary) and prints the
+first training step's loss; with num_processes == 1 it computes the same
+GLOBAL step alone (CHILD_LOCAL_DEVICES devices, default 2) as the reference
+value. The parent asserts all printed losses match.
 
 mode 'driver' runs the FULL pretrain driver (supcon.run) instead of one step:
 epoch loops, meters, process-0-gated checkpointing/logging — the closest this
@@ -20,11 +23,13 @@ import sys
 
 pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
 mode = sys.argv[4] if len(sys.argv) > 4 else "step"
-if nproc == 1:
-    # single-process reference: same 2-way partitioning, one process
+# devices this process contributes; the single-process reference defaults to
+# 2 so it reproduces the same global partitioning as 2 x 1-device processes
+ndev_local = int(os.environ.get("CHILD_LOCAL_DEVICES", "2" if nproc == 1 else "1"))
+if ndev_local > 1:
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=2"
+        + f" --xla_force_host_platform_device_count={ndev_local}"
     ).strip()
 
 import jax
@@ -146,7 +151,7 @@ cfg = SupConStepConfig(
     loss_impl=("ring" if mode == "ring" else "dense"),
 )
 mesh = create_mesh()
-assert mesh.size == 2, mesh
+assert mesh.size == nproc * ndev_local, (mesh, nproc, ndev_local)
 step = make_sharded_train_step(
     model, tx, schedule, cfg, mesh, state_shape=state, donate=False
 )

