@@ -72,23 +72,12 @@ def _compile_with_flops(update, *example_args):
         return update, 0.0, 0.0
 
 
-def main(argv=None):
-    import argparse
-
-    ap = argparse.ArgumentParser("throughput bench")
-    ap.add_argument(
-        "--stem", choices=["conv", "s2d"], default="conv",
-        help="s2d = space-to-depth stem repack A/B (docs/PERF.md roofline)",
-    )
-    args = ap.parse_args(argv)
-
+def _setup_pretrain(mesh, batch, size, stem):
+    """The headline workload: fused SimCLR pretrain step (recipe config)."""
     from simclr_pytorch_distributed_tpu.models import SupConResNet
     from simclr_pytorch_distributed_tpu.ops.augment import AugmentConfig
     from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
-    from simclr_pytorch_distributed_tpu.parallel.mesh import (
-        create_mesh,
-        shard_host_batch,
-    )
+    from simclr_pytorch_distributed_tpu.parallel.mesh import shard_host_batch
     from simclr_pytorch_distributed_tpu.train.state import (
         create_train_state,
         make_optimizer,
@@ -99,17 +88,11 @@ def main(argv=None):
     )
     from simclr_pytorch_distributed_tpu.train.supcon_step import SupConStepConfig
 
-    n_chips = len(jax.devices())
-    device_kind = jax.devices()[0].device_kind
-    peak_tflops = PEAK_TFLOPS_BY_KIND.get(device_kind, DEFAULT_PEAK_TFLOPS)
-    mesh = create_mesh()
-    batch, size = 256, 32
     steps_per_epoch = 50000 // batch
-
     # bf16 compute on the MXU; fp32 params/BN stats/loss.
     model = SupConResNet(
         model_name="resnet50", head="mlp", feat_dim=128, dtype=jnp.bfloat16,
-        stem=args.stem,
+        stem=stem,
     )
     schedule = make_lr_schedule(
         learning_rate=0.5, epochs=100, steps_per_epoch=steps_per_epoch, cosine=True
@@ -118,7 +101,7 @@ def main(argv=None):
     state = create_train_state(
         model, tx, jax.random.key(0), jnp.zeros((2, size, size, 3))
     )
-    loss_impl = resolve_loss_impl("auto", batch, n_chips)
+    loss_impl = resolve_loss_impl("auto", batch, len(jax.devices()))
     step_cfg = SupConStepConfig(
         method="SimCLR", temperature=0.5, epochs=100,
         steps_per_epoch=steps_per_epoch, grad_div=2.0, loss_impl=loss_impl,
@@ -132,14 +115,140 @@ def main(argv=None):
     labels = rng.integers(0, 10, size=(batch,)).astype(np.int32)
     sh_images, sh_labels = shard_host_batch((images, labels), mesh)
 
-    update, flops, bytes_accessed = _compile_with_flops(
-        update, state, sh_images, sh_labels, jax.random.key(0)
+    config = f"SimCLR rn50 cifar-recipe bf16 fused-aug loss={loss_impl}" + (
+        "" if stem == "conv" else f" stem={stem}"
+    )
+    return update, sh_images, sh_labels, state, "pretrain", config
+
+
+def _setup_linear(mesh, batch, size):
+    """The probe workload (reference run_linear.sh): frozen eval-mode rn50
+    encoder forward + classifier update, RRC+flip aug, recipe bs=256."""
+    from simclr_pytorch_distributed_tpu import config as config_lib
+    from simclr_pytorch_distributed_tpu.ops.augment import AugmentConfig
+    from simclr_pytorch_distributed_tpu.parallel.mesh import shard_host_batch
+    from simclr_pytorch_distributed_tpu.train.linear import (
+        build_probe,
+        make_probe_steps,
+        stats_for,
+    )
+
+    cfg = config_lib.LinearConfig(
+        model="resnet50", dataset="cifar10", batch_size=batch,
+        learning_rate=5.0, bf16=True, n_cls=10,
+    )
+    from simclr_pytorch_distributed_tpu.models import SupConResNet
+
+    encoder = SupConResNet(model_name="resnet50", dtype=jnp.bfloat16)
+    enc_vars = encoder.init(
+        jax.random.key(0), jnp.zeros((2, size, size, 3)), train=False
+    )
+    _, classifier, _, tx, state, encode = build_probe(
+        cfg, steps_per_epoch=50000 // batch, encoder_variables=enc_vars
+    )
+    mean, std = stats_for(cfg.dataset)
+    aug_cfg = AugmentConfig(size=size, mean=mean, std=std, color_ops=False)
+    train_jit, _ = make_probe_steps(
+        classifier, tx, encode, aug_cfg, aug_cfg, mesh
+    )
+
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(batch, size, size, 3), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=(batch,)).astype(np.int32)
+    sh_images, sh_labels = shard_host_batch((images, labels), mesh)
+
+    return train_jit, sh_images, sh_labels, state, "probe", (
+        "linear-probe rn50-frozen bf16 rrc+flip lr5 bsz256"
+    )
+
+
+def _setup_ce(mesh, batch, size):
+    """The CE-baseline workload: SupCEResNet train step (train/ce.py)."""
+    from simclr_pytorch_distributed_tpu.models import SupCEResNet
+    from simclr_pytorch_distributed_tpu.ops.augment import AugmentConfig
+    from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
+    from simclr_pytorch_distributed_tpu.parallel.mesh import shard_host_batch
+    from simclr_pytorch_distributed_tpu.train.ce import CEState, make_ce_steps
+    from simclr_pytorch_distributed_tpu.train.linear import stats_for
+    from simclr_pytorch_distributed_tpu.train.state import make_optimizer
+
+    data_parallel = mesh.shape["data"]
+    model = SupCEResNet(
+        model_name="resnet50", num_classes=10, dtype=jnp.bfloat16,
+        sync_bn=False, bn_local_groups=data_parallel,
+    )
+    schedule = make_lr_schedule(
+        learning_rate=0.1, epochs=100, steps_per_epoch=50000 // batch,
+        cosine=True,
+    )
+    tx = make_optimizer(schedule, momentum=0.9, weight_decay=1e-4)
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((2, size, size, 3)), train=True
+    )
+    state = CEState(
+        step=jnp.zeros((), jnp.int32),
+        params=variables["params"],
+        batch_stats=variables["batch_stats"],
+        opt_state=tx.init(variables["params"]),
+    )
+    mean, std = stats_for("cifar10")
+    aug_cfg = AugmentConfig(size=size, mean=mean, std=std, color_ops=False)
+    train_jit, _ = make_ce_steps(model, tx, aug_cfg, mesh)
+
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(batch, size, size, 3), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=(batch,)).astype(np.int32)
+    sh_images, sh_labels = shard_host_batch((images, labels), mesh)
+
+    return train_jit, sh_images, sh_labels, state, "ce", (
+        "supervised-CE rn50 bf16 rrc+flip bsz256"
+    )
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser("throughput bench")
+    ap.add_argument(
+        "--stem", choices=["conv", "s2d"], default="conv",
+        help="s2d = space-to-depth stem repack A/B (docs/PERF.md roofline)",
+    )
+    ap.add_argument(
+        "--stage", choices=["pretrain", "linear", "ce"], default="pretrain",
+        help="workload: contrastive pretrain (headline), linear probe, or "
+             "the CE baseline — same methodology for all three",
+    )
+    args = ap.parse_args(argv)
+    if args.stem != "conv" and args.stage != "pretrain":
+        ap.error("--stem applies to --stage pretrain only")
+
+    from simclr_pytorch_distributed_tpu.parallel.mesh import create_mesh
+
+    n_chips = len(jax.devices())
+    device_kind = jax.devices()[0].device_kind
+    peak_tflops = PEAK_TFLOPS_BY_KIND.get(device_kind, DEFAULT_PEAK_TFLOPS)
+    mesh = create_mesh()
+    batch, size = 256, 32
+
+    if args.stage == "pretrain":
+        setup = _setup_pretrain(mesh, batch, size, args.stem)
+    elif args.stage == "linear":
+        setup = _setup_linear(mesh, batch, size)
+    else:
+        setup = _setup_ce(mesh, batch, size)
+    jit_fn, sh_images, sh_labels, state, metric_stage, config_str = setup
+
+    fn, flops, bytes_accessed = _compile_with_flops(
+        jit_fn, state, sh_images, sh_labels, jax.random.key(0)
     )
     peak_hbm = PEAK_HBM_GBPS_BY_KIND.get(device_kind, DEFAULT_PEAK_HBM_GBPS)
 
+    def run_step(state, key):
+        return fn(state, sh_images, sh_labels, key)
+
     # warmup (compile + first steps); scalar readback = real sync (docstring)
     for i in range(3):
-        state, metrics = update(state, sh_images, sh_labels, jax.random.key(i))
+        state, metrics = run_step(state, jax.random.key(i))
     float(metrics["loss"])
 
     # Median of credible windows (see module docstring for why not best-of-N).
@@ -148,9 +257,7 @@ def main(argv=None):
     for w in range(windows):
         t0 = time.perf_counter()
         for i in range(n_steps):
-            state, metrics = update(
-                state, sh_images, sh_labels, jax.random.key(100 + w * n_steps + i)
-            )
+            state, metrics = run_step(state, jax.random.key(100 + w * n_steps + i))
         float(metrics["loss"])  # D2H readback of a computed value: real sync
         window_dts.append(time.perf_counter() - t0)
 
@@ -196,7 +303,7 @@ def main(argv=None):
         if bytes_accessed > 0 else 0.0
     )
     print(json.dumps({
-        "metric": "pretrain_imgs_per_sec_per_chip",
+        "metric": f"{metric_stage}_imgs_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "imgs/s/chip",
         "vs_baseline": 1.0,
@@ -216,10 +323,7 @@ def main(argv=None):
             "windows_discarded_as_clock_glitch": n_glitched,
             "clock_suspect": clock_suspect,
             "selection": "median of credible windows (implied MFU <= 0.7)",
-            "config": (
-                f"SimCLR rn50 cifar-recipe bf16 fused-aug loss={loss_impl}"
-                + ("" if args.stem == "conv" else f" stem={args.stem}")
-            ),
+            "config": config_str,
         },
     }))
 

@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Measured DRAM (HBM) bandwidth from a jax.profiler xplane capture.
+
+Round-3 PERF.md's "0.97 of the HBM roofline" was an HLO-level UPPER BOUND:
+``cost_analysis()`` byte counts include VMEM-resident fusion traffic. This
+tool closes that gap from the device profiler's own per-op attribution:
+
+- per-op **HBM-only** read/write bytes from the ``memory_access_breakdown``
+  stat (memory_space = HBM entries only — on-chip VMEM/SRAM traffic is
+  excluded), attached by the TPU profiler to every XLA op it timed;
+- **measured** per-op and per-step durations from the trace timeline
+  (the ``Steps`` line of the ``/device:TPU:0`` plane);
+- the device's advertised peak HBM bandwidth from the same plane
+  (``peak_hbm_bw_gigabytes_per_second`` — 819.2 GB/s on v5e).
+
+DRAM utilization = (HBM bytes per step) / (measured step time x peak BW).
+Also prints the top-N ops by HBM traffic with per-op achieved GB/s, so the
+fattest fusions are attributable (VERDICT r3 #2).
+
+Usage:
+    python scripts/xplane_bw.py <trace_dir | path/to/*.xplane.pb> [--top 10]
+
+(Parsing needs the image's tensorflow+xprof protos; run with
+``PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python`` if the binary protobuf
+rejects the pregenerated modules.)
+"""
+
+import argparse
+import collections
+import glob
+import json
+import os
+import sys
+
+
+def _load_xspace(path):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: PLC0415
+
+    if os.path.isdir(path):
+        hits = sorted(
+            glob.glob(os.path.join(path, "**", "*.xplane.pb"), recursive=True)
+        )
+        if not hits:
+            sys.exit(f"no *.xplane.pb under {path}")
+        path = hits[-1]
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs, path
+
+
+def _stat_value(stat):
+    which = stat.WhichOneof("value")
+    return getattr(stat, which) if which else None
+
+
+def _parse_breakdown(raw, memory_accessed_cls):
+    """Wire-decode the repeated MemoryAccessed submessages of the
+    ``memory_access_breakdown`` stat (the wrapper message type is not
+    exported by the installed xprof protos; field 1 = LEN-delimited)."""
+    out, i = [], 0
+    while i < len(raw):
+        tag = raw[i]
+        i += 1
+        if tag != 0x0A:
+            return out  # unknown field past the repeated block: stop
+        ln = shift = 0
+        while True:
+            b = raw[i]
+            i += 1
+            ln |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        ma = memory_accessed_cls()
+        ma.ParseFromString(bytes(raw[i:i + ln]))
+        i += ln
+        out.append(ma)
+    return out
+
+
+def analyze(path, top_n=10, min_step_ms=1.0):
+    from xprof.protobuf import op_metrics_pb2  # noqa: PLC0415
+
+    OpMetrics = op_metrics_pb2.OpMetrics
+    HBM = op_metrics_pb2.MemorySpace.Value("MEMORY_SPACE_HBM")
+
+    xs, resolved = _load_xspace(path)
+    tpu = next((p for p in xs.planes if "/device:TPU" in p.name), None)
+    if tpu is None:
+        sys.exit(f"no TPU plane in {resolved}")
+    stat_names = {k: v.name for k, v in tpu.stat_metadata.items()}
+    plane_stats = {
+        stat_names[s.metadata_id]: _stat_value(s) for s in tpu.stats
+    }
+    peak_gbps = float(plane_stats.get("peak_hbm_bw_gigabytes_per_second", 0.0))
+
+    # per-op-metadata: HLO-model bytes and profiler HBM read/write attribution
+    info = {}
+    for mid, md in tpu.event_metadata.items():
+        stats = {stat_names[s.metadata_id]: _stat_value(s) for s in md.stats}
+        hbm_bytes = 0
+        raw = stats.get("memory_access_breakdown")
+        if isinstance(raw, bytes) and raw:
+            for ma in _parse_breakdown(raw, OpMetrics.MemoryAccessed):
+                if ma.memory_space == HBM:
+                    hbm_bytes += ma.bytes_accessed
+        info[mid] = {
+            "name": md.name,
+            "category": stats.get("hlo_category", ""),
+            "model_bytes": int(stats.get("bytes_accessed", 0) or 0),
+            "hbm_bytes": int(hbm_bytes),
+            "flops": int(stats.get("flops", 0) or 0),
+        }
+
+    peak_flops = float(plane_stats.get("peak_teraflops_per_second", 0.0)) * 1e12
+
+    lines = {l.name: l for l in tpu.lines}
+    for needed in ("Steps", "XLA Ops"):
+        if needed not in lines:
+            sys.exit(
+                f"TPU plane has no '{needed}' line in {resolved} — capture "
+                "the trace around real train steps (--trace_dir on a driver)"
+            )
+    # steady step windows: the Steps line's real train steps (>= min_step_ms),
+    # first one dropped (warm-up / first-donation step)
+    steps = [
+        (e.offset_ps, e.offset_ps + e.duration_ps)
+        for e in lines["Steps"].events
+        if e.duration_ps >= min_step_ms * 1e9
+    ]
+    if len(steps) > 1:
+        steps = steps[1:]
+    if not steps:
+        sys.exit("no step windows >= min_step_ms in the Steps line")
+
+    def in_steps(off):
+        return any(a <= off < b for a, b in steps)
+
+    per_op = collections.defaultdict(lambda: [0, 0.0, 0])  # bytes, ms, count
+    per_cat = collections.defaultdict(lambda: [0, 0.0, 0])  # bytes, ms, flops
+    total_hbm = 0
+    total_model = 0
+    busy_ps = 0
+    mixed_floor_ps = 0.0  # sum over op executions of max(byte time, flop time)
+    for ev in lines["XLA Ops"].events:
+        if not in_steps(ev.offset_ps):
+            continue
+        meta = info.get(ev.metadata_id)
+        if meta is None:
+            continue
+        key = meta["name"]
+        per_op[key][0] += meta["hbm_bytes"]
+        per_op[key][1] += ev.duration_ps / 1e9
+        per_op[key][2] += 1
+        cat = meta["category"] or "uncategorized"
+        per_cat[cat][0] += meta["hbm_bytes"]
+        per_cat[cat][1] += ev.duration_ps / 1e9
+        per_cat[cat][2] += meta["flops"]
+        total_hbm += meta["hbm_bytes"]
+        total_model += meta["model_bytes"]
+        busy_ps += ev.duration_ps
+        byte_time = meta["hbm_bytes"] / (peak_gbps * 1e9) if peak_gbps else 0
+        flop_time = meta["flops"] / peak_flops if peak_flops else 0
+        mixed_floor_ps += max(byte_time, flop_time) * 1e12
+
+    n_steps = len(steps)
+    step_ms = sum(b - a for a, b in steps) / 1e9 / n_steps
+    hbm_per_step = total_hbm / n_steps
+    util = hbm_per_step / (step_ms / 1e3) / (peak_gbps * 1e9) if peak_gbps else 0
+    busy_util = (
+        total_hbm / (busy_ps / 1e12) / (peak_gbps * 1e9) if busy_ps else 0
+    )
+
+    rows = sorted(
+        (
+            {
+                "op": k[:88],
+                "category": "",
+                "hbm_gb_per_step": v[0] / n_steps / 1e9,
+                "ms_per_step": v[1] / n_steps,
+                "achieved_gbps": (v[0] / 1e9) / (v[1] / 1e3) if v[1] else 0.0,
+                "pct_of_step_traffic": 100.0 * v[0] / total_hbm,
+            }
+            for k, v in per_op.items()
+        ),
+        key=lambda r: -r["hbm_gb_per_step"],
+    )[:top_n]
+
+    categories = {
+        cat: {
+            "ms_per_step": round(v[1] / n_steps, 3),
+            "hbm_gb_per_step": round(v[0] / n_steps / 1e9, 3),
+            "achieved_gbps": round((v[0] / 1e9) / (v[1] / 1e3), 1) if v[1] else 0,
+            "mfu": round(
+                (v[2] / n_steps) / ((v[1] / n_steps / 1e3) * peak_flops), 3
+            ) if v[1] and peak_flops else 0,
+        }
+        for cat, v in sorted(per_cat.items(), key=lambda x: -x[1][1])
+    }
+    mixed_floor_ms = mixed_floor_ps / 1e9 / n_steps
+    result = {
+        "xplane": resolved,
+        "n_steps": n_steps,
+        "measured_step_ms": round(step_ms, 3),
+        "peak_hbm_gbps": round(peak_gbps, 1),
+        "hbm_gb_per_step": round(hbm_per_step / 1e9, 3),
+        "model_gb_per_step": round(total_model / n_steps / 1e9, 3),
+        "measured_dram_utilization_of_step": round(util, 4),
+        "dram_utilization_of_op_busy_time": round(busy_util, 4),
+        # per-op-execution max(HBM-byte time, flop time), summed: the
+        # roofline floor for THIS op mix with no fusion changes
+        "mixed_roofline_floor_ms": round(mixed_floor_ms, 3),
+        "fraction_of_mixed_roofline": round(mixed_floor_ms / step_ms, 4)
+        if step_ms else 0,
+        "categories": categories,
+        "top_ops": rows,
+    }
+    return result
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace", help="trace dir or .xplane.pb path")
+    ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--markdown", action="store_true",
+                    help="also print a markdown table of the top ops")
+    args = ap.parse_args()
+    result = analyze(args.trace, top_n=args.top)
+    print(json.dumps(result))
+    if args.markdown:
+        print()
+        print("| op | GB/step (HBM) | ms/step | achieved GB/s | % of traffic |")
+        print("|---|---|---|---|---|")
+        for r in result["top_ops"]:
+            print(
+                f"| `{r['op'][:60]}` | {r['hbm_gb_per_step']:.3f} "
+                f"| {r['ms_per_step']:.3f} | {r['achieved_gbps']:.0f} "
+                f"| {r['pct_of_step_traffic']:.1f} |"
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -172,7 +172,7 @@ def run_validation(eval_jit, params, val_images, val_labels, batch_size, mesh):
     recompiles; every example counts exactly once.
     """
     n = len(val_images)
-    totals = {"loss_sum": 0.0, "top1": 0.0, "top5": 0.0, "n": 0.0}
+    totals = None
     for lo in range(0, n, batch_size):
         chunk_img = val_images[lo:lo + batch_size]
         chunk_lab = val_labels[lo:lo + batch_size]
@@ -184,8 +184,11 @@ def run_validation(eval_jit, params, val_images, val_labels, batch_size, mesh):
             valid = np.concatenate([valid, np.zeros(pad, np.float32)])
         batch = shard_host_batch((chunk_img, chunk_lab, valid), mesh)
         m = eval_jit(params, *batch)
-        for k in totals:
-            totals[k] += float(m[k])
+        # accumulate ON DEVICE: a float() here would sync every batch and
+        # stall the async dispatch pipeline (round-3 weak #5); the single
+        # readback below is the only host sync of the validation pass
+        totals = m if totals is None else jax.tree.map(jnp.add, totals, m)
+    totals = {k: float(v) for k, v in totals.items()}
     return {
         "loss": totals["loss_sum"] / totals["n"],
         "top1": 100.0 * totals["top1"] / totals["n"],
