@@ -7,8 +7,9 @@ BASELINE.md).
 The moment real data is reachable this is ONE command with zero decisions
 left:
 
-    python scripts/northstar.py                      # both points
+    python scripts/northstar.py                      # both cifar10 points
     python scripts/northstar.py --points 200         # just the headline
+    python scripts/northstar.py --dataset cifar100   # the cifar100 table rows
     python scripts/northstar.py --dry-run            # plumbing check, no data
 
 It (a) fetches CIFAR-10 if absent and egress exists (urllib + md5, the
@@ -31,8 +32,12 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# published reference points: epochs -> (top1, top5)  (README.md:44-45)
-PUBLISHED = {100: (84.76, 99.36), 200: (89.05, 99.69)}
+# published reference points: dataset -> epochs -> (top1, top5)
+# (reference README.md:44-45 for cifar10, :51-52 for cifar100; BASELINE.md)
+PUBLISHED = {
+    "cifar10": {100: (84.76, 99.36), 200: (89.05, 99.69)},
+    "cifar100": {100: (58.43, 85.26), 200: (65.73, 89.64)},
+}
 TOLERANCE = 0.5  # BASELINE.md north star: within +-0.5 of 89.05
 
 
@@ -74,11 +79,12 @@ def newest_run_dir(workdir, dataset, suffix):
 
 def run_point(epochs, args):
     """Pretrain + probe one north-star point; returns the result record."""
-    dataset = "synthetic_hard32" if args.dry_run else "cifar10"
+    dataset = "synthetic_hard32" if args.dry_run else args.dataset
     trial = f"{args.trial}_{epochs}ep"
     pre_epochs = 2 if args.dry_run else epochs
     probe_epochs = 2 if args.dry_run else 100  # reference probe default
-    logs = os.path.join(args.workdir, f"northstar_{trial}")
+    # dataset in the path: a cifar100 run must not clobber cifar10's logs
+    logs = os.path.join(args.workdir, f"northstar_{dataset}_{trial}")
     os.makedirs(logs, exist_ok=True)
 
     # the exact run_supcon.sh recipe (reference 2-GPU launch; --ngpu 2 keeps
@@ -111,9 +117,9 @@ def run_point(epochs, args):
     )
     top1, top5 = parse_probe_log(probe_log)
 
-    pub1, pub5 = PUBLISHED[epochs]
+    pub1, pub5 = PUBLISHED[args.dataset][epochs]
     record = {
-        "metric": f"northstar_cifar10_probe_top1_{epochs}ep",
+        "metric": f"northstar_{args.dataset}_probe_top1_{epochs}ep",
         "value": top1, "top5": top5,
         "published_top1": pub1, "published_top5": pub5,
         "tolerance": TOLERANCE,
@@ -131,8 +137,9 @@ def run_point(epochs, args):
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", choices=sorted(PUBLISHED), default="cifar10")
     ap.add_argument("--points", type=int, nargs="+", default=[100, 200],
-                    choices=sorted(PUBLISHED))
+                    choices=[100, 200])
     ap.add_argument("--workdir", default=os.path.join(REPO, "work_space"))
     ap.add_argument("--data_folder", default=os.path.join(REPO, "datasets"))
     ap.add_argument("--seed", type=int, default=0)
@@ -144,14 +151,17 @@ def main():
 
     if not args.dry_run and not args.no_download:
         # fetch up front so a missing-egress failure is loud and immediate
-        from simclr_pytorch_distributed_tpu.data.cifar import maybe_download
+        from simclr_pytorch_distributed_tpu.data.cifar import (
+            CIFAR_ARCHIVES,
+            maybe_download,
+        )
 
-        maybe_download("cifar10", args.data_folder)
-        marker = os.path.join(args.data_folder, "cifar-10-batches-py")
+        maybe_download(args.dataset, args.data_folder)
+        marker = os.path.join(args.data_folder, CIFAR_ARCHIVES[args.dataset][2])
         if not os.path.isdir(marker):
             sys.exit(
-                f"CIFAR-10 not at {marker} and download failed (no egress?) "
-                "— place the python-version binaries there and re-run"
+                f"{args.dataset} not at {marker} and download failed (no "
+                "egress?) — place the python-version binaries there and re-run"
             )
 
     ok = True

@@ -60,3 +60,29 @@ def test_config_dict_json_safe(tmp_path):
 
     cfg = parse_supcon(["--workdir", str(tmp_path)])
     json.dumps(config_dict(cfg))  # must not raise
+
+
+def test_download_flag(tmp_path):
+    """--no_download flips the (default-on) CIFAR fetch fallback; both
+    parsers carry it (torchvision download=True parity, main_supcon.py:181)."""
+    assert parse_supcon(["--workdir", str(tmp_path)]).download
+    assert not parse_supcon(
+        ["--no_download", "--workdir", str(tmp_path)]
+    ).download
+    assert parse_linear(["--workdir", str(tmp_path)]).download
+    assert not parse_linear(
+        ["--no_download", "--workdir", str(tmp_path)]
+    ).download
+
+
+def test_ce_syncbn_flag(tmp_path):
+    """--syncBN exists on the CE parser only (the probe's encoder is frozen
+    eval-mode; the reference pretrain conditional, main_supcon.py:223-224)."""
+    import pytest
+
+    ce = parse_linear(["--syncBN", "--workdir", str(tmp_path)], ce=True)
+    assert ce.syncBN
+    assert not parse_linear([
+        "--workdir", str(tmp_path)], ce=True).syncBN
+    with pytest.raises(SystemExit):
+        parse_linear(["--syncBN", "--workdir", str(tmp_path)], ce=False)
