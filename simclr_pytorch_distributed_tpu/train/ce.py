@@ -63,7 +63,10 @@ class CEState(struct.PyTreeNode):
 def make_ce_steps(model, tx, aug_cfg, mesh):
     repl = replicated_sharding(mesh)
 
-    def train_step(state: CEState, images_u8, labels, key):
+    def train_step(state: CEState, images_u8, labels, base_key):
+        # fold_in INSIDE the program (state.step == the driver's global step;
+        # host-side per-step fold_in = an H2D transfer per step, docs/PERF.md)
+        key = jax.random.fold_in(base_key, state.step)
         images = augment_batch(key, images_u8, aug_cfg)
 
         def loss_fn(params):
@@ -188,9 +191,8 @@ def run(cfg: config_lib.LinearConfig):
                 top1.update(100.0 * m["top1"] / cfg.batch_size, cfg.batch_size)
 
         for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
-            key = jax.random.fold_in(base_key, (epoch - 1) * steps_per_epoch + idx)
             batch = shard_host_batch((images_u8, labels), mesh)
-            state, m = train_jit(state, batch[0], batch[1], key)
+            state, m = train_jit(state, batch[0], batch[1], base_key)
             buffer.append(idx, m)
             if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
                 fold_metrics()

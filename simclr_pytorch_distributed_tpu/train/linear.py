@@ -122,7 +122,11 @@ def topk_correct(logits: jax.Array, labels: jax.Array, ks=(1, 5)):
 def make_probe_steps(classifier, tx, encode, aug_cfg, eval_cfg, mesh):
     repl = replicated_sharding(mesh)
 
-    def train_step(state: ProbeState, images_u8, labels, key):
+    def train_step(state: ProbeState, images_u8, labels, base_key):
+        # fold_in INSIDE the program (state.step == the driver's global
+        # step): a host-side per-step fold_in costs an H2D scalar transfer
+        # that throttles this small step on a tunneled chip (docs/PERF.md)
+        key = jax.random.fold_in(base_key, state.step)
         images = augment_batch(key, images_u8, aug_cfg)
 
         def loss_fn(params):
@@ -266,9 +270,8 @@ def run(cfg: config_lib.LinearConfig):
 
         end = time.time()
         for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
-            key = jax.random.fold_in(base_key, (epoch - 1) * steps_per_epoch + idx)
             batch = shard_host_batch((images_u8, labels), mesh)
-            state, m = train_jit(state, batch[0], batch[1], key)
+            state, m = train_jit(state, batch[0], batch[1], base_key)
             buffer.append(idx, m)
             if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
                 fold_metrics()

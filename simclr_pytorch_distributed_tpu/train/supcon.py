@@ -158,10 +158,19 @@ def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1
 
 
 def make_fused_update(model, tx, schedule, step_cfg, aug_cfg, mesh, state_example):
-    """augment(two crops) + train step as one GSPMD program."""
+    """augment(two crops) + train step as one GSPMD program.
+
+    ``base_key`` is the run's base PRNG key, passed UNCHANGED every step: the
+    per-step key is ``fold_in(base_key, state.step)`` INSIDE the program.
+    Deriving it on the host (`fold_in` per step) costs a host->device scalar
+    transfer per call — ~5 ms/step on a tunneled chip, where it throttled the
+    small probe/CE steps (docs/PERF.md); ``state.step`` equals the driver's
+    global step, so the key stream (and therefore training) is bit-identical.
+    """
     train_step = make_train_step(model, tx, schedule, step_cfg, mesh=mesh)
 
-    def update(state: TrainState, images_u8, labels, key):
+    def update(state: TrainState, images_u8, labels, base_key):
+        key = jax.random.fold_in(base_key, state.step)
         views = two_crop_batch(key, images_u8, aug_cfg)
         return train_step(state, views, labels)
 
@@ -227,9 +236,10 @@ def train_one_epoch(
     for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
         data_time.update(time.time() - end)
         global_step = (epoch - 1) * steps_per_epoch + idx
-        key = jax.random.fold_in(base_key, global_step)
         batch = shard_host_batch((images_u8, labels), mesh)
-        state, metrics = update_fn(state, batch[0], batch[1], key)
+        # per-step key = fold_in(base_key, state.step) INSIDE the program
+        # (state.step == global_step); see make_fused_update
+        state, metrics = update_fn(state, batch[0], batch[1], base_key)
         buffer.append((idx, global_step), metrics)
         if tracer is not None:
             tracer.step(global_step)
