@@ -12,9 +12,9 @@ frozen encoder, and compares top-1 against its pre-registered bar:
 
 - ``rn50_100ep``: bar **95.7** (round-3 two-seed floor 96.09/96.54 minus the
   protocol's ~0.4-pt seed margin);
-- ``rn18_100ep``: bar **95.4** (round-4 calibration run measured **96.43**
-  with this exact seed/config — `work_space/ratchet_r4cal_rn18_100ep/` —
-  minus a 1-pt single-seed margin);
+- ``rn18_100ep``: bar **95.4** (round-4 two-seed measurements 96.43 (seed 0)
+  / 97.82 (seed 1) — `work_space/ratchet_r4{cal,seed1}_rn18_100ep/` — the
+  bar is the floor minus a 1-pt margin);
 - ``rn50_200ep``: bar **98.8** (round-3 measured 99.27 at 200 epochs; minus
   a 0.5-pt margin).
 
