@@ -23,43 +23,56 @@ from simclr_pytorch_distributed_tpu.data.cifar import (
 )
 
 
-def _tiny_cifar10_archive(root, n_per_batch=4):
-    """A structurally real cifar-10-python.tar.gz (5 train batches + test)."""
+def _tiny_archive(dataset, n=4):
+    """A structurally real CIFAR tar.gz, tiny (returns (bytes, md5))."""
     rng = np.random.default_rng(0)
+    if dataset == "cifar10":
+        members = [
+            (f"cifar-10-batches-py/{name}", "labels", 10)
+            for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]
+        ]
+    else:
+        members = [(f"cifar-100-python/{s}", "fine_labels", 100)
+                   for s in ("train", "test")]
     buf = io.BytesIO()
     with tarfile.open(fileobj=buf, mode="w:gz") as tar:
-        for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        for member, label_key, n_cls in members:
             payload = pickle.dumps({
-                "data": rng.integers(
-                    0, 256, (n_per_batch, 3072), dtype=np.uint8
-                ),
-                "labels": rng.integers(0, 10, n_per_batch).tolist(),
+                "data": rng.integers(0, 256, (n, 3072), dtype=np.uint8),
+                label_key: rng.integers(0, n_cls, n).tolist(),
             })
-            info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+            info = tarfile.TarInfo(member)
             info.size = len(payload)
             tar.addfile(info, io.BytesIO(payload))
     data = buf.getvalue()
-    fname = CIFAR_ARCHIVES["cifar10"][0]
-    path = os.path.join(root, fname)
-    with open(path, "wb") as f:
-        f.write(data)
-    return hashlib.md5(data).hexdigest()
+    return data, hashlib.md5(data).hexdigest()
 
 
-@pytest.fixture
-def http_site(tmp_path):
+def _serve_archive(tmp_path, dataset):
+    """Start an HTTP server hosting a tiny archive; returns (url, md5, stop)."""
     site = tmp_path / "site"
     site.mkdir()
-    md5 = _tiny_cifar10_archive(str(site))
+    data, md5 = _tiny_archive(dataset)
+    (site / CIFAR_ARCHIVES[dataset][0]).write_bytes(data)
     handler = functools.partial(SimpleHTTPRequestHandler, directory=str(site))
     server = HTTPServer(("127.0.0.1", 0), handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
-    try:
-        yield f"http://127.0.0.1:{server.server_port}", md5
-    finally:
+
+    def stop():
         server.shutdown()
         thread.join()
+
+    return f"http://127.0.0.1:{server.server_port}", md5, stop
+
+
+@pytest.fixture
+def http_site(tmp_path):
+    url, md5, stop = _serve_archive(tmp_path, "cifar10")
+    try:
+        yield url, md5
+    finally:
+        stop()
 
 
 def test_download_extract_load_end_to_end(http_site, tmp_path):
@@ -115,6 +128,22 @@ def test_ensure_dataset_available_lock_flow(http_site, tmp_path, monkeypatch):
     # non-cifar datasets and download=False are no-ops
     cifar_lib.ensure_dataset_available("synthetic", str(dest))
     cifar_lib.ensure_dataset_available("cifar10", str(dest), download=False)
+
+
+def test_download_cifar100_archive_shape(tmp_path):
+    """The cifar100 archive constants (name, marker dir, pickle layout) drive
+    the same fetch->extract->load path northstar --dataset cifar100 uses."""
+    url, md5, stop = _serve_archive(tmp_path, "cifar100")
+    try:
+        dest = tmp_path / "data"
+        marker = download_cifar("cifar100", str(dest), base_url=url, md5=md5)
+        assert os.path.isdir(marker)
+        train, test, n_cls = load_dataset("cifar100", str(dest))
+        assert n_cls == 100
+        assert train["images"].shape == (4, 32, 32, 3)
+        assert test["labels"].shape == (4,)
+    finally:
+        stop()
 
 
 def test_maybe_download_swallows_network_failure(tmp_path, caplog):
