@@ -115,8 +115,9 @@ def _setup_pretrain(mesh, batch, size, stem):
     labels = rng.integers(0, 10, size=(batch,)).astype(np.int32)
     sh_images, sh_labels = shard_host_batch((images, labels), mesh)
 
-    config = f"SimCLR rn50 cifar-recipe bf16 fused-aug loss={loss_impl}" + (
-        "" if stem == "conv" else f" stem={stem}"
+    config = (
+        f"SimCLR rn50 cifar-recipe bf16 fused-aug bsz{batch} loss={loss_impl}"
+        + ("" if stem == "conv" else f" stem={stem}")
     )
     return update, sh_images, sh_labels, state, "pretrain", config
 
@@ -157,8 +158,10 @@ def _setup_linear(mesh, batch, size):
     labels = rng.integers(0, 10, size=(batch,)).astype(np.int32)
     sh_images, sh_labels = shard_host_batch((images, labels), mesh)
 
-    return train_jit, sh_images, sh_labels, state, "probe", (
-        "linear-probe rn50-frozen bf16 rrc+flip lr5 bsz256"
+    # stage token matches the CLI choice (--stage linear) so scripts keying
+    # the metric name off the flag find it
+    return train_jit, sh_images, sh_labels, state, "linear", (
+        f"linear-probe rn50-frozen bf16 rrc+flip lr5 bsz{batch}"
     )
 
 
@@ -201,7 +204,7 @@ def _setup_ce(mesh, batch, size):
     sh_images, sh_labels = shard_host_batch((images, labels), mesh)
 
     return train_jit, sh_images, sh_labels, state, "ce", (
-        "supervised-CE rn50 bf16 rrc+flip bsz256"
+        f"supervised-CE rn50 bf16 rrc+flip bsz{batch}"
     )
 
 
@@ -218,6 +221,12 @@ def main(argv=None):
         help="workload: contrastive pretrain (headline), linear probe, or "
              "the CE baseline — same methodology for all three",
     )
+    ap.add_argument(
+        "--batch_size", type=int, default=256,
+        help="global batch per chip (32 = one v5e-8 shard of the recipe's "
+             "256, the per-device workload for the multi-chip projection in "
+             "docs/PERF.md)",
+    )
     args = ap.parse_args(argv)
     if args.stem != "conv" and args.stage != "pretrain":
         ap.error("--stem applies to --stage pretrain only")
@@ -228,7 +237,7 @@ def main(argv=None):
     device_kind = jax.devices()[0].device_kind
     peak_tflops = PEAK_TFLOPS_BY_KIND.get(device_kind, DEFAULT_PEAK_TFLOPS)
     mesh = create_mesh()
-    batch, size = 256, 32
+    batch, size = args.batch_size, 32
 
     if args.stage == "pretrain":
         setup = _setup_pretrain(mesh, batch, size, args.stem)

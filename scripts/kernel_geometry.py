@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Fused-sharded loss kernels vs the dense path at PER-DEVICE pod geometry.
+
+Round-4 verdict weak #2: ``resolve_loss_impl('auto')`` picks the sharded
+fused kernel on any multi-device TPU mesh, but its win was only ever measured
+at m=512 anchor rows (single chip, full batch). On the v5e-8 north-star
+config each device owns m = 2*256/8 = **64** anchor rows x 512 contrast
+columns — an 8x-skinnier Pallas grid. This script times, on the real chip:
+
+- **fused**: the exact rectangular kernels the sharded path runs per device
+  (``ops/pallas_loss.py _fwd_call`` + ``_bwd_call`` — local anchor rows vs
+  the all-gathered contrast matrix, logits tiles VMEM-only, backward from
+  the gathered O(N) lse/cnt vectors);
+- **dense**: ``jax.value_and_grad`` of the same per-device slice computed the
+  dense way (the [m, N] logits block + softmax temporaries materialized, XLA
+  saving residuals for the backward) — what GSPMD hands each device under
+  ``loss_impl='dense'``.
+
+Both paths exclude the feature all-gather (identical O(N*D) cost in either
+mode, so it cancels in the comparison). Honest-sync methodology per
+docs/PERF.md: every timed window chains each iteration on the previous
+result (no async pipelining of independent dispatches) and ends with a
+host readback of a computed scalar; median of 5 windows.
+
+Usage:  python scripts/kernel_geometry.py [--rows 64 128 256 512] [--json OUT]
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simclr_pytorch_distributed_tpu.ops.pallas_loss import (  # noqa: E402
+    _bwd_call,
+    _fwd_call,
+    _pick_block,
+)
+
+N = 512          # global view rows: batch 256 x 2 views (the recipe config)
+D = 128          # feat_dim
+TEMP, BASE_TEMP = 0.5, 0.07
+NEG = -1e30
+
+
+def _make_inputs(m, seed=0):
+    rng = np.random.default_rng(seed)
+    feats = rng.standard_normal((N, D)).astype(np.float32)
+    feats /= np.linalg.norm(feats, axis=1, keepdims=True)
+    ids = np.tile(np.arange(N // 2, dtype=np.int32), 2)  # SimCLR sample ids
+    return (
+        jnp.asarray(feats[:m]), jnp.asarray(feats),
+        jnp.asarray(ids[:m]), jnp.asarray(ids),
+        jnp.arange(m, dtype=jnp.int32), jnp.arange(N, dtype=jnp.int32),
+    )
+
+
+def _fused_core(m):
+    bm, bn = _pick_block(m, 256), _pick_block(N, 512)
+    coeff = (TEMP / BASE_TEMP) / N
+    interpret = jax.default_backend() != "tpu"
+
+    def step(frow, fcol, idr, idc, grow, gcol, lse_all, cnt_all):
+        loss_rows, lse, cnt = _fwd_call(
+            frow, fcol, idr, idc, grow, gcol,
+            TEMP, BASE_TEMP, interpret, bm, bn,
+        )
+        d = _bwd_call(
+            frow, fcol, idr, idc, grow, gcol,
+            lse[:, 0], lse_all, cnt[:, 0], cnt_all,
+            TEMP, coeff, interpret, bm, bn,
+        )
+        # the 1e-20 term keeps the backward alive in the chained loop below
+        # without perturbing the loss (not foldable: d is a runtime value)
+        return jnp.mean(loss_rows) + jnp.sum(jnp.abs(d)) * 1e-20
+
+    return step
+
+
+def _dense_core(m):
+    def local_loss(frow, fcol, idr, idc, grow, gcol):
+        logits = (frow @ fcol.T) / TEMP                    # [m, N] in HBM
+        self_mask = grow[:, None] == gcol[None, :]
+        pos = ((idr[:, None] == idc[None, :]) & ~self_mask).astype(jnp.float32)
+        masked = jnp.where(self_mask, NEG, logits)
+        # detached row max, as the reference subtracts (losses.py:68-69)
+        row_max = jax.lax.stop_gradient(jnp.max(masked, axis=1, keepdims=True))
+        shifted = masked - row_max
+        log_prob = shifted - jnp.log(
+            jnp.sum(jnp.exp(shifted), axis=1, keepdims=True)
+        )
+        mean_pos = jnp.sum(pos * log_prob, axis=1) / jnp.sum(pos, axis=1)
+        return jnp.mean(-(TEMP / BASE_TEMP) * mean_pos)
+
+    grad_fn = jax.value_and_grad(local_loss, argnums=(0, 1))
+
+    def step(frow, fcol, idr, idc, grow, gcol, lse_all, cnt_all):
+        loss, (dfrow, dfcol) = grad_fn(frow, fcol, idr, idc, grow, gcol)
+        return loss + (jnp.sum(jnp.abs(dfrow)) + jnp.sum(jnp.abs(dfcol))) * 1e-20
+
+    return step
+
+
+def _time_fn(core, args, iters=100, windows=5):
+    """ms per fwd+bwd, dispatch amortized: ``iters`` iterations run INSIDE
+    one jitted fori_loop (each chained on the previous scalar, so the loop
+    cannot be parallelized or hoisted), one dispatch + one computed-scalar
+    readback per window. A separate 1-iteration program measures the
+    dispatch+readback floor, subtracted from the per-iter quotient. On this
+    tunneled chip the floor is ~2 ms — larger than the kernels themselves —
+    which is why a python-loop-of-dispatches cannot measure these shapes."""
+
+    def make(n_iters):
+        @jax.jit
+        def run(tick, *a):
+            def body(_, t):
+                frow = a[0] + t * 1e-20  # data-dependence on the prior iter
+                return core(frow, *a[1:])
+            return jax.lax.fori_loop(0, n_iters, body, tick)
+        return run
+
+    looped, single = make(iters), make(1)
+    tick = jnp.float32(0.0)
+    float(looped(tick, *args)); float(single(tick, *args))  # compile+warm
+
+    def window_times(fn):
+        dts, t = [], jnp.float32(0.0)
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            t = fn(t, *args)
+            out = float(t)  # computed-scalar readback: the only real sync
+            dts.append(time.perf_counter() - t0)
+            assert np.isfinite(out)
+        return statistics.median(dts)
+
+    floor = window_times(single)           # dispatch + readback + 1 iter
+    total = window_times(looped)           # dispatch + readback + N iters
+    return max(total - floor, 0.0) / (iters - 1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, nargs="+", default=[64, 128, 256, 512],
+                    help="anchor rows per device (64 = v5e-8 at batch 256)")
+    ap.add_argument("--iters", type=int, default=5000)
+    ap.add_argument("--json", default=None, help="also write records here")
+    args = ap.parse_args()
+    if args.iters < 2:
+        ap.error("--iters must be >= 2 (per-iter time divides by iters - 1)")
+
+    records = []
+    for m in args.rows:
+        frow, fcol, idr, idc, grow, gcol = _make_inputs(m)
+        # column-side softmax stats: in the real sharded backward these are
+        # the all-gathered residuals; here computed once, outside the window
+        _, lse_full, cnt_full = _fwd_call(
+            fcol, fcol, idc, idc, gcol, gcol, TEMP, BASE_TEMP,
+            jax.default_backend() != "tpu",
+            _pick_block(N, 256), _pick_block(N, 512),
+        )
+        lse_all, cnt_all = lse_full[:, 0], cnt_full[:, 0]
+        common = (frow, fcol, idr, idc, grow, gcol, lse_all, cnt_all)
+
+        fused_ms = _time_fn(_fused_core(m), common, iters=args.iters) * 1e3
+        dense_ms = _time_fn(_dense_core(m), common, iters=args.iters) * 1e3
+        rec = {
+            "metric": "loss_kernel_fwd_bwd_ms_per_device",
+            "anchor_rows": m, "contrast_cols": N, "feat_dim": D,
+            "fused_ms": round(fused_ms, 4), "dense_ms": round(dense_ms, 4),
+            # None = the dense window was swallowed by dispatch-floor noise
+            "fused_over_dense": (
+                round(fused_ms / dense_ms, 3) if dense_ms > 0 else None
+            ),
+            "device": jax.devices()[0].device_kind,
+            "note": "per-device kernel work only; all-gather excluded "
+                    "(identical in both modes)",
+        }
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(records, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -41,11 +41,19 @@ PUBLISHED = {
 TOLERANCE = 0.5  # BASELINE.md north star: within +-0.5 of 89.05
 
 
+class PointFailed(RuntimeError):
+    """One north-star point died; the remaining points must still run and
+    every point must emit its JSON record (the ratchet.py ConfigFailed
+    pattern — a dead point must not eat the records the CI parses)."""
+
+
 def run(cmd, log_path):
     with open(log_path, "w") as f:
         proc = subprocess.run(cmd, cwd=REPO, stdout=f, stderr=subprocess.STDOUT)
     if proc.returncode != 0:
-        sys.exit(f"FAILED ({proc.returncode}): {' '.join(cmd)}; see {log_path}")
+        raise PointFailed(
+            f"FAILED ({proc.returncode}): {' '.join(cmd)}; see {log_path}"
+        )
 
 
 def parse_probe_log(log_path):
@@ -61,7 +69,7 @@ def parse_probe_log(log_path):
                 if m1:
                     best = (float(m1.group(1)), None)
     if best is None:
-        sys.exit(f"no 'best accuracy' line in {log_path}")
+        raise PointFailed(f"no 'best accuracy' line in {log_path}")
     return best
 
 
@@ -73,7 +81,7 @@ def newest_run_dir(workdir, dataset, suffix):
         if d.endswith(suffix)
     ]
     if not runs:
-        sys.exit(f"no run dir matching *{suffix} in {models}")
+        raise PointFailed(f"no run dir matching *{suffix} in {models}")
     return max(runs, key=os.path.getmtime)
 
 
@@ -166,7 +174,17 @@ def main():
 
     ok = True
     for epochs in args.points:
-        record = run_point(epochs, args)
+        try:
+            record = run_point(epochs, args)
+        except PointFailed as e:
+            pub1, pub5 = PUBLISHED[args.dataset][epochs]
+            record = {
+                "metric": f"northstar_{args.dataset}_probe_top1_{epochs}ep",
+                "value": None, "top5": None,
+                "published_top1": pub1, "published_top5": pub5,
+                "tolerance": TOLERANCE, "ok": False,
+                "dry_run": args.dry_run, "error": str(e),
+            }
         print(json.dumps(record), flush=True)
         ok = ok and record["ok"]
     sys.exit(0 if ok else 1)

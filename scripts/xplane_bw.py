@@ -134,8 +134,18 @@ def analyze(path, top_n=10, min_step_ms=1.0):
     if not steps:
         sys.exit("no step windows >= min_step_ms in the Steps line")
 
-    def in_steps(off):
-        return any(a <= off < b for a, b in steps)
+    def step_fraction(off, dur):
+        """Fraction of [off, off+dur) inside the step windows: events
+        straddling a window edge are clipped and their bytes/flops pro-rated
+        instead of being wholly included (start-in-window) or wholly dropped
+        (start-before-window) — removes the edge bias in hbm_gb_per_step."""
+        if dur <= 0:
+            return 1.0 if any(a <= off < b for a, b in steps) else 0.0
+        end = off + dur
+        overlap = sum(
+            max(0, min(end, b) - max(off, a)) for a, b in steps
+        )
+        return overlap / dur
 
     per_op = collections.defaultdict(lambda: [0, 0.0, 0])  # bytes, ms, count
     per_cat = collections.defaultdict(lambda: [0, 0.0, 0])  # bytes, ms, flops
@@ -144,25 +154,26 @@ def analyze(path, top_n=10, min_step_ms=1.0):
     busy_ps = 0
     mixed_floor_ps = 0.0  # sum over op executions of max(byte time, flop time)
     for ev in lines["XLA Ops"].events:
-        if not in_steps(ev.offset_ps):
+        frac = step_fraction(ev.offset_ps, ev.duration_ps)
+        if frac <= 0.0:
             continue
         meta = info.get(ev.metadata_id)
         if meta is None:
             continue
         key = meta["name"]
-        per_op[key][0] += meta["hbm_bytes"]
-        per_op[key][1] += ev.duration_ps / 1e9
+        per_op[key][0] += meta["hbm_bytes"] * frac
+        per_op[key][1] += ev.duration_ps * frac / 1e9
         per_op[key][2] += 1
         cat = meta["category"] or "uncategorized"
-        per_cat[cat][0] += meta["hbm_bytes"]
-        per_cat[cat][1] += ev.duration_ps / 1e9
-        per_cat[cat][2] += meta["flops"]
-        total_hbm += meta["hbm_bytes"]
-        total_model += meta["model_bytes"]
-        busy_ps += ev.duration_ps
+        per_cat[cat][0] += meta["hbm_bytes"] * frac
+        per_cat[cat][1] += ev.duration_ps * frac / 1e9
+        per_cat[cat][2] += meta["flops"] * frac
+        total_hbm += meta["hbm_bytes"] * frac
+        total_model += meta["model_bytes"] * frac
+        busy_ps += ev.duration_ps * frac
         byte_time = meta["hbm_bytes"] / (peak_gbps * 1e9) if peak_gbps else 0
         flop_time = meta["flops"] / peak_flops if peak_flops else 0
-        mixed_floor_ps += max(byte_time, flop_time) * 1e12
+        mixed_floor_ps += max(byte_time, flop_time) * frac * 1e12
 
     n_steps = len(steps)
     step_ms = sum(b - a for a, b in steps) / 1e9 / n_steps

@@ -81,14 +81,23 @@ def download_cifar(
     archive = os.path.join(root, fname)
     if not (os.path.exists(archive) and _md5(archive) == want_md5):
         url = f"{base_url or CIFAR_BASE_URL}/{fname}"
-        tmp = archive + ".partial"
-        with urllib.request.urlopen(url, timeout=timeout) as r, open(tmp, "wb") as f:
-            shutil.copyfileobj(r, f)
-        got = _md5(tmp)
-        if got != want_md5:
-            os.remove(tmp)
-            raise ValueError(f"md5 mismatch for {url}: got {got}, want {want_md5}")
-        os.replace(tmp, archive)  # atomic: no torn archive on the hit path
+        # pid-unique temp: concurrent writers (possible after a stale-lock
+        # break, ensure_dataset_available) never share an inode; the winner's
+        # os.replace is atomic either way
+        tmp = archive + f".partial.{os.getpid()}"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r, open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            got = _md5(tmp)
+            if got != want_md5:
+                raise ValueError(
+                    f"md5 mismatch for {url}: got {got}, want {want_md5}"
+                )
+            os.replace(tmp, archive)  # atomic: no torn archive on the hit path
+        finally:
+            # failed/aborted transfer: do not orphan a pid-unique partial
+            if os.path.exists(tmp):
+                os.remove(tmp)
 
     with tarfile.open(archive, "r:gz") as tar:
         try:
@@ -247,8 +256,13 @@ def ensure_dataset_available(
     layout), so instead EVERY process races on an ``O_EXCL`` lock file in the
     data folder itself: exactly one downloader per filesystem, co-located
     processes wait for the lock to clear, and a final barrier keeps the
-    multi-host launch in step. A stale lock (crashed downloader) times out
-    and the waiter retries the download itself.
+    multi-host launch in step. A holder killed hard (SIGKILL/OOM) leaves the
+    lock file behind; waiters break locks older than ``stale_after`` (the
+    acquisition time is stamped in the file's mtime + contents) and retry the
+    acquisition themselves rather than sleeping out the full window. Breaking
+    a live-but-old lock at worst yields two concurrent downloaders, which is
+    safe: each writes a pid-unique ``.partial.<pid>`` temp and commits via
+    atomic ``os.replace`` after an md5 check (``download_cifar``).
     """
     if not download or dataset not in CIFAR_ARCHIVES or not data_folder:
         return
@@ -260,19 +274,46 @@ def ensure_dataset_available(
     if not os.path.isdir(marker):
         os.makedirs(data_folder, exist_ok=True)
         lock = os.path.join(data_folder, f".{dataset}.download.lock")
-        try:
-            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-        except FileExistsError:
-            deadline = time.time() + 1800
-            while os.path.exists(lock) and time.time() < deadline:
-                time.sleep(2)
-            maybe_download(dataset, data_folder)  # no-op if the peer finished
-        else:
+        stale_after = 1800.0
+        while True:
+            # the marker dir appears at the START of tar extraction — only
+            # marker-present AND lock-clear means the writer is finished
+            # (a waiter exiting on the marker alone could read half-extracted
+            # batch files)
+            if os.path.isdir(marker) and not os.path.exists(lock):
+                break
             try:
-                maybe_download(dataset, data_folder)
-            finally:
-                os.close(fd)
-                os.unlink(lock)
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                try:
+                    age = time.time() - os.path.getmtime(lock)
+                except OSError:
+                    continue  # released between check and stat: retry acquire
+                if age > stale_after:
+                    # dead (or absurdly slow) holder: break the lock and
+                    # compete for it; FileNotFoundError = another waiter won
+                    try:
+                        os.unlink(lock)
+                    except FileNotFoundError:
+                        pass
+                    continue
+                time.sleep(2)
+            else:
+                try:
+                    os.write(fd, f"{os.getpid()} {time.time():.0f}\n".encode())
+                    maybe_download(dataset, data_folder)
+                finally:
+                    # unlink ONLY our own lock: if a waiter broke us as stale
+                    # and re-acquired, the path now names the successor's lock
+                    # — deleting it would cascade into N concurrent
+                    # downloaders (ownership = inode identity)
+                    try:
+                        if os.stat(lock).st_ino == os.fstat(fd).st_ino:
+                            os.unlink(lock)
+                    except OSError:
+                        pass  # already broken/replaced by a waiter
+                    os.close(fd)
+                break  # download failed (no egress): load_dataset will report
     sync_processes("dataset_ready")
 
 

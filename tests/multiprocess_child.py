@@ -137,7 +137,11 @@ from simclr_pytorch_distributed_tpu.train.supcon_step import (
     make_sharded_train_step,
 )
 
-B, size = 8, 8
+# mode 'fused'/'fused_supcon' needs >= 8 anchor rows per device (the sharded
+# kernel's tiling floor, ops/pallas_loss.py _pick_block): global batch 16 ->
+# 32 view rows -> m=8 on the 4-device topologies.
+B = 16 if mode.startswith("fused") else 8
+size = 8
 model = SupConResNet(model_name="resnet10")
 schedule = make_lr_schedule(
     learning_rate=0.05, epochs=2, steps_per_epoch=2, cosine=True
@@ -145,10 +149,19 @@ schedule = make_lr_schedule(
 tx = make_optimizer(schedule, momentum=0.9, weight_decay=1e-4)
 state = create_train_state(model, tx, jax.random.key(0), jnp.zeros((2, size, size, 3)))
 cfg = SupConStepConfig(
-    method="SimCLR", temperature=0.5, epochs=2, steps_per_epoch=2, grad_div=2.0,
+    # 'fused_supcon' drives the label-carrying (SupCon) leg of the sharded
+    # fused kernel; every other mode keeps the SimCLR recipe
+    method=("SupCon" if mode == "fused_supcon" else "SimCLR"),
+    temperature=0.5, epochs=2, steps_per_epoch=2, grad_div=2.0,
     # mode 'ring': the ppermute-rotating sharded loss across REAL process
-    # boundaries — the DP step only exercises psum/all-gather over gloo
-    loss_impl=("ring" if mode == "ring" else "dense"),
+    # boundaries — the DP step only exercises psum/all-gather over gloo.
+    # mode 'fused'/'fused_supcon': the shard_map-sharded Pallas kernel
+    # (interpret mode on CPU), the exact path resolve_loss_impl('auto')
+    # selects on multi-device TPU meshes — its check_vma=False/psum-cotangent
+    # custom VJP is the plumbing most at risk across process boundaries.
+    loss_impl={"ring": "ring", "fused": "fused", "fused_supcon": "fused"}.get(
+        mode, "dense"
+    ),
 )
 mesh = create_mesh()
 assert mesh.size == nproc * ndev_local, (mesh, nproc, ndev_local)
@@ -166,7 +179,10 @@ loader = EpochLoader(
     process_index=jax.process_index(), process_count=jax.process_count(),
     prefetch=0,
 )
-imgs_local, labs_local = next(iter(loader.epoch(1)))
-batch = shard_host_batch((imgs_local, labs_local), mesh)
-new_state, metrics = step(state, batch[0], batch[1])
+# TWO steps: step 2's loss depends on step 1's parameter update, so the
+# printed value witnesses the BACKWARD (grad + optimizer + collectives)
+# across the process boundary, not just the forward loss reduction.
+for imgs_local, labs_local in loader.epoch(1):
+    batch = shard_host_batch((imgs_local, labs_local), mesh)
+    state, metrics = step(state, batch[0], batch[1])
 print(f"LOSS {float(metrics['loss']):.8f}", flush=True)

@@ -130,6 +130,36 @@ def test_ensure_dataset_available_lock_flow(http_site, tmp_path, monkeypatch):
     cifar_lib.ensure_dataset_available("cifar10", str(dest), download=False)
 
 
+def test_ensure_dataset_available_breaks_stale_lock(
+    http_site, tmp_path, monkeypatch
+):
+    """A lock left behind by a hard-killed downloader (SIGKILL/OOM) must be
+    broken, not slept on for the full 1800s window: the waiter unlinks the
+    stale lock, takes it over, and completes the download itself."""
+    import time
+
+    from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib
+
+    base_url, md5 = http_site
+    fname, _, marker = cifar_lib.CIFAR_ARCHIVES["cifar10"]
+    monkeypatch.setattr(cifar_lib, "CIFAR_BASE_URL", base_url)
+    monkeypatch.setitem(
+        cifar_lib.CIFAR_ARCHIVES, "cifar10", (fname, md5, marker)
+    )
+    dest = tmp_path / "data"
+    dest.mkdir()
+    lock = dest / ".cifar10.download.lock"
+    lock.write_text("99999 0\n")  # dead pid
+    stale = time.time() - 3600  # acquired "an hour ago"
+    os.utime(lock, (stale, stale))
+
+    t0 = time.time()
+    cifar_lib.ensure_dataset_available("cifar10", str(dest))
+    assert time.time() - t0 < 60  # did not sleep out the window
+    assert (dest / marker).is_dir()
+    assert not lock.exists()
+
+
 def test_download_cifar100_archive_shape(tmp_path):
     """The cifar100 archive constants (name, marker dir, pickle layout) drive
     the same fetch->extract->load path northstar --dataset cifar100 uses."""

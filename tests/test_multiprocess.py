@@ -90,13 +90,17 @@ def _loss_of(out: str) -> float:
     raise AssertionError(f"no LOSS line in:\n{out}")
 
 
-@pytest.mark.parametrize("mode", ["step", "ring"])
+@pytest.mark.parametrize("mode", ["step", "ring", "fused"])
 def test_two_process_step_matches_single_process(mode):
-    """One training step across two REAL processes equals the single-process
-    run of the identical global batch. 'step' exercises the dense loss (XLA
+    """Two training steps across two REAL processes equal the single-process
+    run of the identical global batches (the second step's loss witnesses the
+    first step's gradients). 'step' exercises the dense loss (XLA
     psum/all-gather over gloo); 'ring' exercises the ring loss, whose rotating
     ppermute is a different collective that only a multi-process run proves
-    gloo carries."""
+    gloo carries; 'fused' exercises the shard_map-sharded Pallas kernel —
+    the path resolve_loss_impl('auto') picks on multi-device TPU meshes,
+    whose check_vma=False/psum-cotangent custom VJP is exactly the plumbing
+    that could behave differently when the mesh spans processes."""
     ref = _loss_of(_run_children(1, _free_port(), mode=mode)[0])
     outs = _run_children(2, _free_port(), mode=mode)
     losses = [_loss_of(o) for o in outs]
@@ -122,14 +126,19 @@ def _run_driver_children(tmp_path, mode, extra_args=(), timeout=900,
     return _reap(procs, timeout)
 
 
-@pytest.mark.parametrize("mode", ["step", "ring"])
+@pytest.mark.parametrize("mode", ["step", "ring", "fused", "fused_supcon"])
 def test_two_process_two_device_step_matches_single_process(mode):
     """The REAL pod topology: 2 processes x 2 local devices (global mesh of
     4) equals one process with a 4-device mesh. This is where host-batch
     slicing (per-process halves) meets device sharding (per-device quarters),
     and where the ring's ppermute hops cross a process boundary on some edges
     and stay host-local on others — untested by either the 8-virtual-device
-    suite or the 1-device-per-process tests above (round-3 weak #3)."""
+    suite or the 1-device-per-process tests above (round-3 weak #3).
+    'fused'/'fused_supcon' run the sharded Pallas kernel — the mode `auto`
+    selects on a real v5e pod (round-4 weak #1): anchor rows sharded 4-way
+    (m=8 each), contrast all-gathered across the process boundary, and the
+    custom VJP's per-shard cotangent psum crossing gloo; 'fused_supcon'
+    additionally carries the replicated global-label leg."""
     ref = _loss_of(
         _run_children(1, _free_port(), mode=mode, local_devices=4)[0]
     )
