@@ -99,23 +99,41 @@ def download_cifar(
             if os.path.exists(tmp):
                 os.remove(tmp)
 
-    with tarfile.open(archive, "r:gz") as tar:
+    if os.path.isdir(marker_dir):
+        # a concurrent caller finished the extraction while we were fetching
+        return marker_dir
+    # Extract into a pid-unique staging dir and atomically rename the marker
+    # into place: marker presence therefore means extraction COMPLETE, which
+    # is what every fast-path marker check in this module assumes (extracting
+    # straight into root would expose a half-written tree under that name).
+    stage = os.path.join(root, f".extract.{os.getpid()}")
+    try:
+        os.makedirs(stage, exist_ok=True)
+        with tarfile.open(archive, "r:gz") as tar:
+            try:
+                # 'data' filter: refuse abs paths / parent traversal / links
+                tar.extractall(stage, filter="data")
+            except TypeError:  # Python < 3.10.12 predates the filter kwarg
+                base = os.path.realpath(stage)
+                for m in tar.getmembers():
+                    target = os.path.realpath(os.path.join(stage, m.name))
+                    if not target.startswith(base + os.sep):
+                        raise ValueError(f"unsafe tar member path: {m.name}")
+                    if m.islnk() or m.issym():
+                        raise ValueError(f"refusing tar link member: {m.name}")
+                tar.extractall(stage)
+        staged = os.path.join(stage, marker)
+        if not os.path.isdir(staged):
+            raise FileNotFoundError(
+                f"{fname} extracted but {marker} did not appear under {stage}"
+            )
         try:
-            # 'data' filter: refuse absolute paths / parent traversal / links
-            tar.extractall(root, filter="data")
-        except TypeError:  # Python < 3.10.12 predates the filter kwarg
-            base = os.path.realpath(root)
-            for m in tar.getmembers():
-                target = os.path.realpath(os.path.join(root, m.name))
-                if not target.startswith(base + os.sep):
-                    raise ValueError(f"unsafe tar member path: {m.name}")
-                if m.islnk() or m.issym():
-                    raise ValueError(f"refusing tar link member: {m.name}")
-            tar.extractall(root)
-    if not os.path.isdir(marker_dir):
-        raise FileNotFoundError(
-            f"{fname} extracted but {marker} did not appear under {root}"
-        )
+            os.rename(staged, marker_dir)  # atomic on the same filesystem
+        except OSError:
+            if not os.path.isdir(marker_dir):  # not lost-the-race: real error
+                raise
+    finally:
+        shutil.rmtree(stage, ignore_errors=True)
     return marker_dir
 
 
@@ -253,19 +271,26 @@ def ensure_dataset_available(
 
     Drivers call this before ``load_dataset``. Gating on the global process 0
     would strand hosts with their own local ``data_folder`` (the normal pod-VM
-    layout), so instead EVERY process races on an ``O_EXCL`` lock file in the
-    data folder itself: exactly one downloader per filesystem, co-located
-    processes wait for the lock to clear, and a final barrier keeps the
-    multi-host launch in step. A holder killed hard (SIGKILL/OOM) leaves the
-    lock file behind; waiters break locks older than ``stale_after`` (the
-    acquisition time is stamped in the file's mtime + contents) and retry the
-    acquisition themselves rather than sleeping out the full window. Breaking
-    a live-but-old lock at worst yields two concurrent downloaders, which is
-    safe: each writes a pid-unique ``.partial.<pid>`` temp and commits via
-    atomic ``os.replace`` after an md5 check (``download_cifar``).
+    layout), so instead EVERY process serializes on a kernel ``flock`` over a
+    lock file in the data folder itself: exactly one downloader per
+    filesystem, and download + md5 + tar extraction ALL complete while the
+    lock is held, so a waiter that acquires it next either sees the finished
+    marker dir (no-op) or retries the download itself — never a
+    half-extracted tree. ``flock`` (not lock-file existence) is what makes
+    this crash-safe: a holder killed hard (SIGKILL/OOM) has its lock released
+    by the kernel immediately, so waiters neither sleep out a staleness
+    window nor race to break/unlink a path that another waiter may have just
+    re-acquired (the round-5 review found both races in the previous
+    existence-based design). The lock FILE is deliberately never unlinked —
+    removing it would reintroduce the unlink/recreate race; a leftover
+    ~24-byte ``.{dataset}.download.lock`` is the cost. A waiter that cannot
+    acquire the lock within an hour logs a warning and proceeds without
+    downloading (``load_dataset`` stays the loud failure path).
     """
     if not download or dataset not in CIFAR_ARCHIVES or not data_folder:
         return
+    import fcntl
+    import logging
     import time
 
     from simclr_pytorch_distributed_tpu.parallel.mesh import sync_processes
@@ -274,46 +299,37 @@ def ensure_dataset_available(
     if not os.path.isdir(marker):
         os.makedirs(data_folder, exist_ok=True)
         lock = os.path.join(data_folder, f".{dataset}.download.lock")
-        stale_after = 1800.0
-        while True:
-            # the marker dir appears at the START of tar extraction — only
-            # marker-present AND lock-clear means the writer is finished
-            # (a waiter exiting on the marker alone could read half-extracted
-            # batch files)
-            if os.path.isdir(marker) and not os.path.exists(lock):
-                break
-            try:
-                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            except FileExistsError:
+        fd = os.open(lock, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            deadline = time.time() + 3600.0
+            acquired = False
+            while time.time() < deadline:
                 try:
-                    age = time.time() - os.path.getmtime(lock)
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    acquired = True
+                    break
                 except OSError:
-                    continue  # released between check and stat: retry acquire
-                if age > stale_after:
-                    # dead (or absurdly slow) holder: break the lock and
-                    # compete for it; FileNotFoundError = another waiter won
-                    try:
-                        os.unlink(lock)
-                    except FileNotFoundError:
-                        pass
-                    continue
-                time.sleep(2)
+                    time.sleep(2)
+            if acquired:
+                try:
+                    os.ftruncate(fd, 0)
+                    os.write(fd, f"{os.getpid()} {time.time():.0f}\n".encode())
+                    if not os.path.isdir(marker):  # re-check UNDER the lock
+                        maybe_download(dataset, data_folder)
+                finally:
+                    fcntl.flock(fd, fcntl.LOCK_UN)
             else:
                 try:
-                    os.write(fd, f"{os.getpid()} {time.time():.0f}\n".encode())
-                    maybe_download(dataset, data_folder)
-                finally:
-                    # unlink ONLY our own lock: if a waiter broke us as stale
-                    # and re-acquired, the path now names the successor's lock
-                    # — deleting it would cascade into N concurrent
-                    # downloaders (ownership = inode identity)
-                    try:
-                        if os.stat(lock).st_ino == os.fstat(fd).st_ino:
-                            os.unlink(lock)
-                    except OSError:
-                        pass  # already broken/replaced by a waiter
-                    os.close(fd)
-                break  # download failed (no egress): load_dataset will report
+                    holder = os.pread(fd, 64, 0).decode("ascii", "replace")
+                except OSError:
+                    holder = "?"
+                logging.warning(
+                    "gave up waiting for %s after 3600s; proceeding without "
+                    "download (holder pid/time: %s)",
+                    lock, holder.strip() or "?",
+                )
+        finally:
+            os.close(fd)
     sync_processes("dataset_ready")
 
 

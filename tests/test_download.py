@@ -111,8 +111,12 @@ def test_download_idempotent_without_network(http_site, tmp_path):
 
 
 def test_ensure_dataset_available_lock_flow(http_site, tmp_path, monkeypatch):
-    """The driver entry point: O_EXCL-locked download (one downloader per
-    filesystem, the multi-host-safe gate) + barrier, lock removed after."""
+    """The driver entry point: flock-serialized download (one downloader per
+    filesystem, the multi-host-safe gate) + barrier. The lock FILE persists
+    by design (unlinking it would reintroduce the unlink/recreate race) but
+    must hold no active flock afterwards."""
+    import fcntl
+
     from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib
 
     base_url, md5 = http_site
@@ -124,18 +128,27 @@ def test_ensure_dataset_available_lock_flow(http_site, tmp_path, monkeypatch):
     dest = tmp_path / "data"
     cifar_lib.ensure_dataset_available("cifar10", str(dest))
     assert (dest / marker).is_dir()
-    assert not (dest / ".cifar10.download.lock").exists()
+    lock = dest / ".cifar10.download.lock"
+    assert lock.exists()  # kept on purpose; contents identify the downloader
+    fd = os.open(lock, os.O_RDWR)
+    try:
+        # must not block: the downloader released its flock
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        fcntl.flock(fd, fcntl.LOCK_UN)
+    finally:
+        os.close(fd)
     # non-cifar datasets and download=False are no-ops
     cifar_lib.ensure_dataset_available("synthetic", str(dest))
     cifar_lib.ensure_dataset_available("cifar10", str(dest), download=False)
 
 
-def test_ensure_dataset_available_breaks_stale_lock(
+def test_ensure_dataset_available_dead_holder_lock(
     http_site, tmp_path, monkeypatch
 ):
-    """A lock left behind by a hard-killed downloader (SIGKILL/OOM) must be
-    broken, not slept on for the full 1800s window: the waiter unlinks the
-    stale lock, takes it over, and completes the download itself."""
+    """A lock file left behind by a hard-killed downloader (SIGKILL/OOM) must
+    not block at all: the kernel released the dead process's flock with it,
+    so a new process acquires immediately — no staleness window to sleep out
+    and no lock-breaking races (the round-5 redesign's point)."""
     import time
 
     from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib
@@ -149,15 +162,49 @@ def test_ensure_dataset_available_breaks_stale_lock(
     dest = tmp_path / "data"
     dest.mkdir()
     lock = dest / ".cifar10.download.lock"
-    lock.write_text("99999 0\n")  # dead pid
-    stale = time.time() - 3600  # acquired "an hour ago"
-    os.utime(lock, (stale, stale))
+    lock.write_text("99999 0\n")  # leftover file from a dead pid, no flock
 
     t0 = time.time()
     cifar_lib.ensure_dataset_available("cifar10", str(dest))
-    assert time.time() - t0 < 60  # did not sleep out the window
+    assert time.time() - t0 < 60  # no staleness window
     assert (dest / marker).is_dir()
-    assert not lock.exists()
+
+
+def test_ensure_dataset_available_concurrent_callers(
+    http_site, tmp_path, monkeypatch
+):
+    """Three concurrent callers (flock is per-open-file-description, so
+    threads serialize exactly like processes do): exactly one downloads,
+    the rest block on the flock and then see the completed marker — and the
+    extracted tree is fully readable afterwards (no half-extracted state
+    can escape the lock)."""
+    import threading
+
+    from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib
+
+    base_url, md5 = http_site
+    fname, _, marker = cifar_lib.CIFAR_ARCHIVES["cifar10"]
+    monkeypatch.setattr(cifar_lib, "CIFAR_BASE_URL", base_url)
+    monkeypatch.setitem(
+        cifar_lib.CIFAR_ARCHIVES, "cifar10", (fname, md5, marker)
+    )
+    dest = tmp_path / "data"
+    errs = []
+
+    def call():
+        try:
+            cifar_lib.ensure_dataset_available("cifar10", str(dest))
+        except Exception as e:  # noqa: BLE001 — surfaced via assert below
+            errs.append(e)
+
+    threads = [threading.Thread(target=call) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    train, test_split, n_cls = load_dataset("cifar10", str(dest))
+    assert n_cls == 10 and train["images"].shape[0] == 20
 
 
 def test_download_cifar100_archive_shape(tmp_path):
