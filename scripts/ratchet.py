@@ -70,7 +70,10 @@ CONFIGS = {
     # CE bar: two measurements exist — 99.72 (round 3,
     # docs/evidence/ce_30ep.log) and 99.00 (round-5 validation run,
     # docs/evidence/ratchet_r5_ce_cal.json) — bar = the 99.00 floor minus a
-    # 0.8-pt margin.
+    # 0.8-pt margin. Seed-pinned like the SupCon config: at seed 1 this
+    # config never leaves the uniform-logit plateau (10.6 = chance; lr 0.05
+    # rescues it to 98.94 — RESULTS.md round-5 seed-sensitivity note), so do
+    # not swap seeds without recalibrating.
     "ce_rn50_30ep": dict(model="resnet50", epochs=30, bar=98.2, kind="ce",
                          dataset="synthetic_hard"),
 }
