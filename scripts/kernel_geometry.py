@@ -28,16 +28,16 @@ Usage:  python scripts/kernel_geometry.py [--rows 64 128 256 512] [--json OUT]
 import argparse
 import json
 import os
-import statistics
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from _honest_timing import time_per_iter  # noqa: E402
 from simclr_pytorch_distributed_tpu.ops.pallas_loss import (  # noqa: E402
     _bwd_call,
     _fwd_call,
@@ -67,7 +67,7 @@ def _fused_core(m):
     coeff = (TEMP / BASE_TEMP) / N
     interpret = jax.default_backend() != "tpu"
 
-    def step(frow, fcol, idr, idc, grow, gcol, lse_all, cnt_all):
+    def step(i, frow, fcol, idr, idc, grow, gcol, lse_all, cnt_all):
         loss_rows, lse, cnt = _fwd_call(
             frow, fcol, idr, idc, grow, gcol,
             TEMP, BASE_TEMP, interpret, bm, bn,
@@ -101,48 +101,11 @@ def _dense_core(m):
 
     grad_fn = jax.value_and_grad(local_loss, argnums=(0, 1))
 
-    def step(frow, fcol, idr, idc, grow, gcol, lse_all, cnt_all):
+    def step(i, frow, fcol, idr, idc, grow, gcol, lse_all, cnt_all):
         loss, (dfrow, dfcol) = grad_fn(frow, fcol, idr, idc, grow, gcol)
         return loss + (jnp.sum(jnp.abs(dfrow)) + jnp.sum(jnp.abs(dfcol))) * 1e-20
 
     return step
-
-
-def _time_fn(core, args, iters=100, windows=5):
-    """ms per fwd+bwd, dispatch amortized: ``iters`` iterations run INSIDE
-    one jitted fori_loop (each chained on the previous scalar, so the loop
-    cannot be parallelized or hoisted), one dispatch + one computed-scalar
-    readback per window. A separate 1-iteration program measures the
-    dispatch+readback floor, subtracted from the per-iter quotient. On this
-    tunneled chip the floor is ~2 ms — larger than the kernels themselves —
-    which is why a python-loop-of-dispatches cannot measure these shapes."""
-
-    def make(n_iters):
-        @jax.jit
-        def run(tick, *a):
-            def body(_, t):
-                frow = a[0] + t * 1e-20  # data-dependence on the prior iter
-                return core(frow, *a[1:])
-            return jax.lax.fori_loop(0, n_iters, body, tick)
-        return run
-
-    looped, single = make(iters), make(1)
-    tick = jnp.float32(0.0)
-    float(looped(tick, *args)); float(single(tick, *args))  # compile+warm
-
-    def window_times(fn):
-        dts, t = [], jnp.float32(0.0)
-        for _ in range(windows):
-            t0 = time.perf_counter()
-            t = fn(t, *args)
-            out = float(t)  # computed-scalar readback: the only real sync
-            dts.append(time.perf_counter() - t0)
-            assert np.isfinite(out)
-        return statistics.median(dts)
-
-    floor = window_times(single)           # dispatch + readback + 1 iter
-    total = window_times(looped)           # dispatch + readback + N iters
-    return max(total - floor, 0.0) / (iters - 1)
 
 
 def main():
@@ -168,8 +131,8 @@ def main():
         lse_all, cnt_all = lse_full[:, 0], cnt_full[:, 0]
         common = (frow, fcol, idr, idc, grow, gcol, lse_all, cnt_all)
 
-        fused_ms = _time_fn(_fused_core(m), common, iters=args.iters) * 1e3
-        dense_ms = _time_fn(_dense_core(m), common, iters=args.iters) * 1e3
+        fused_ms = time_per_iter(_fused_core(m), common, iters=args.iters) * 1e3
+        dense_ms = time_per_iter(_dense_core(m), common, iters=args.iters) * 1e3
         rec = {
             "metric": "loss_kernel_fwd_bwd_ms_per_device",
             "anchor_rows": m, "contrast_cols": N, "feat_dim": D,
