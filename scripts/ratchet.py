@@ -19,8 +19,8 @@ runs the supervised trainer end-to-end on ``synthetic_hard``. Bars:
 - ``rn18_100ep``: bar **95.4** (round-4 two-seed measurements 96.43 (seed 0)
   / 97.82 (seed 1) — `work_space/ratchet_r4{cal,seed1}_rn18_100ep/` — the
   bar is the floor minus a 1-pt margin);
-- ``rn50_200ep``: bar **98.8** (round-3 measured 99.27 at 200 epochs; minus
-  a 0.5-pt margin);
+- ``rn50_200ep``: bar **98.8** (round-3 measured 99.27 at 200 epochs minus a
+  0.5-pt margin; round-5 two-seed floor 99.22/99.55 keeps it 0.42 pts clear);
 - ``supcon_rn50_50ep``: bar **90.0** (round-5 calibration measured 92.52 on
   the chip; see CONFIGS note);
 - ``ce_rn50_30ep``: bar **98.2** (measured 99.72 round-3 and 99.00 round-5;
