@@ -328,6 +328,14 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         enabled=is_main_process(),
     )
 
+    # The per-epoch crash backup as ONE jitted program: mapping bare
+    # ``jnp.copy`` over the tree dispatches ~30 op-by-op ``jit(copy)``
+    # programs whose caches all miss AGAIN at epoch 2 (the post-update state
+    # carries mesh shardings the fresh epoch-1 state lacked), costing ~20 s
+    # of sub-second compiles that the persistent cache never keeps. One
+    # program = one compile per sharding layout, persisted across runs.
+    copy_state = jax.jit(lambda s: jax.tree.map(jnp.copy, s))
+
     try:
         for epoch in range(start_epoch, cfg.epochs + 1):
             t1 = time.time()
@@ -335,7 +343,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
             # `state` object is DELETED after the first step — an un-donated
             # on-device copy (one HBM->HBM copy per epoch) is what the crash
             # handler can still save.
-            backup = jax.tree.map(jnp.copy, state) if cfg.nan_guard else None
+            backup = copy_state(state) if cfg.nan_guard else None
             try:
                 state, loss_avg, metrics = train_one_epoch(
                     epoch, loader, update_fn, state, mesh, base_key, cfg, tb,
