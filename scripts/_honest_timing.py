@@ -26,6 +26,12 @@ import numpy as np
 
 def time_per_iter(core, args, iters=100, windows=5):
     """Median seconds per iteration of ``core`` over ``windows`` windows."""
+    if iters < 2:
+        # the dispatch floor is subtracted via the (iters - 1) quotient below:
+        # iters=1 would divide by zero AFTER the warmup compiles, and iters<1
+        # would silently mismeasure — fail loudly before any work instead
+        # (callers pass CLI --iters values straight through)
+        raise ValueError(f"iters must be >= 2 to subtract the dispatch floor, got {iters}")
 
     def make(n_iters):
         @jax.jit

@@ -29,6 +29,7 @@ Usage:  python scripts/crop_ab.py [--batch 256] [--json OUT]
 """
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -121,17 +122,34 @@ def _kernel_core(crop_fn):
     return core
 
 
+@contextlib.contextmanager
+def _patched_crop(crop_fn):
+    """Swap the production crop backend for the whole timing call.
+
+    The monkeypatch must bracket EVERY compilation of the timed program, not
+    just the first trace: patching inside the traced core only works while
+    that exact trace is live, and any re-trace (a jit cache miss from new
+    input avals, a second harness window) would silently time the wrong
+    backend (ADVICE.md round 5). Patching around ``time_per_iter`` — which
+    owns all compiles of its looped/single programs — closes that hole.
+    """
+    saved = augment.crop_and_resize
+    augment.crop_and_resize = crop_fn
+    try:
+        yield
+    finally:
+        augment.crop_and_resize = saved
+
+
 def _pipeline_core(crop_fn):
     cfg = augment.AugmentConfig()
 
     def core(i, imgs, base_key):
         key = jax.random.fold_in(base_key, i)
-        saved = augment.crop_and_resize
-        augment.crop_and_resize = crop_fn
-        try:
-            out = augment.two_crop_batch(key, imgs, cfg)
-        finally:
-            augment.crop_and_resize = saved
+        # crop_fn reaches two_crop_batch via the module global, patched at
+        # the make_core level (_patched_crop around the whole timing call)
+        assert augment.crop_and_resize is crop_fn, "time under _patched_crop"
+        out = augment.two_crop_batch(key, imgs, cfg)
         return jnp.sum(out) * 1e-20
 
     return core
@@ -155,14 +173,20 @@ def main():
     imgs_255 = imgs_f * 255.0
 
     records = []
-    for level, make_core, iters, inputs in (
-        ("crop_kernel", _kernel_core, args.iters_kernel, imgs_f),
-        ("two_crop_pipeline", _pipeline_core, args.iters_pipeline, imgs_255),
+    for level, make_core, iters, inputs, needs_patch in (
+        ("crop_kernel", _kernel_core, args.iters_kernel, imgs_f, False),
+        ("two_crop_pipeline", _pipeline_core, args.iters_pipeline, imgs_255, True),
     ):
-        matmul_s = time_per_iter(
-            make_core(augment.crop_and_resize), (inputs, base_key), iters)
-        gather_s = time_per_iter(
-            make_core(crop_and_resize_gather), (inputs, base_key), iters)
+        def timed(crop_fn):
+            # pipeline level: the patch brackets every compile inside
+            # time_per_iter (see _patched_crop); the kernel level calls
+            # crop_fn directly and needs no patch
+            ctx = _patched_crop(crop_fn) if needs_patch else contextlib.nullcontext()
+            with ctx:
+                return time_per_iter(make_core(crop_fn), (inputs, base_key), iters)
+
+        matmul_s = timed(augment.crop_and_resize)
+        gather_s = timed(crop_and_resize_gather)
         rec = {
             "metric": f"crop_ab_{level}_ms",
             "batch": args.batch,

@@ -1,0 +1,79 @@
+"""Version-compat shims for the small jax API surface whose spelling moved.
+
+The repo targets the current jax API (``jax.shard_map`` with ``check_vma``,
+``jax.lax.pvary``), but deployment images pin older releases where
+``shard_map`` still lives in ``jax.experimental.shard_map`` with the
+``check_rep`` keyword and the vma/pvary typing system does not exist yet.
+Everything funnels through here so a version bump is a one-file change and an
+old runtime degrades gracefully instead of dying at import time (the
+pre-compat failure mode: ``from jax import shard_map`` ImportError'd the
+whole train package, taking every driver — and the preemption/resume
+machinery — down with it).
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.6: public API, vma typing
+    from jax import shard_map as _shard_map
+
+    _NEW_API = True
+except ImportError:  # older jax: experimental module, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _NEW_API = False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the modern keyword spelling on every version.
+
+    ``check_vma`` maps onto the old API's ``check_rep`` — same meaning
+    (replication/varying-axes type checking), renamed upstream.
+    """
+    if _NEW_API:
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def pvary(x, axis_names):
+    """Mark ``x`` device-varying over ``axis_names`` for shard_map's vma
+    typing; identity where the vma system doesn't exist (pre-pvary jax has no
+    replication types to satisfy, so there is nothing to mark)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, tuple(axis_names), to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, tuple(axis_names))
+    return x
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis from inside shard_map.
+
+    ``jax.lax.axis_size`` on current jax; on older releases the frame lookup
+    returns the size directly (an int) from the axis environment.
+    """
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    from jax._src import core as _core
+
+    frame = _core.axis_frame(axis_name)
+    return int(frame) if isinstance(frame, int) else int(frame.size)
+
+
+def shape_dtype_struct(shape, dtype, vma=None):
+    """``jax.ShapeDtypeStruct`` whose ``vma`` keyword only exists on jax
+    versions with the vma typing system; ``vma=None`` (always the case on
+    older jax — see :func:`pvary`) needs no keyword at all."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # pre-vma jax given a non-None vma: nothing to type
+        return jax.ShapeDtypeStruct(shape, dtype)

@@ -102,6 +102,11 @@ class SupConConfig:
     compile_cache: str = "auto"
     # abort + emergency-checkpoint on NaN/Inf loss (utils/guard.py)
     nan_guard: bool = True
+    # what to DO about a non-finite loss (utils/guard.py FailurePolicy):
+    # 'abort' dies after the crash_epoch_N save; 'rollback' restores the
+    # epoch-boundary backup, skips the poisoned epoch with the LR halved,
+    # and continues (bounded by guard.MAX_ROLLBACKS)
+    nan_policy: str = "abort"
     # per-block activation rematerialization: trades recompute FLOPs for HBM
     # so bigger per-chip batches fit (identical numerics; models/resnet.py)
     remat: bool = False
@@ -187,6 +192,10 @@ def supcon_parser() -> argparse.ArgumentParser:
     _add_bool_flag(p, "remat", help="remat residual blocks (HBM for recompute)")
     p.add_argument("--nan_guard", type=_parse_bool,
                    default=d.nan_guard, help="abort + checkpoint on NaN loss")
+    p.add_argument("--nan_policy", type=str, default=d.nan_policy,
+                   choices=["abort", "rollback"],
+                   help="on NaN loss: die after the crash save, or restore "
+                        "the epoch backup, halve the LR, and continue")
     return p
 
 
@@ -260,6 +269,9 @@ class LinearConfig:
     download: bool = True  # fetch CIFAR if absent (torchvision parity)
     ckpt: str = ""
     # TPU-native additions
+    # CE trainer only: full-state (step-granular) resume, same semantics as
+    # the pretrain --resume; the probe ignores it (no full-state checkpoints)
+    resume: str = ""
     data_folder: str = "./datasets/"
     size: int = 32
     val_batch_size: int = 256  # main_ce.py:64-66
@@ -298,9 +310,16 @@ def linear_parser(ce: bool = False) -> argparse.ArgumentParser:
     _add_bool_flag(p, "warm")
     if ce:
         _add_bool_flag(p, "syncBN")
+        p.add_argument("--resume", type=str, default=d.resume,
+                       help="checkpoint (or run dir) to resume from")
     if not ce:
         p.add_argument("--ckpt", type=str, default=d.ckpt,
                        help="path to pre-trained model checkpoint dir")
+        p.add_argument("--resume", type=str, default=d.resume,
+                       help="accepted for the exit-75 launcher contract "
+                            "(re-run the same command with --resume); the "
+                            "probe keeps no full-state checkpoints, so it "
+                            "retrains from scratch")
     p.add_argument("--data_folder", type=str, default=d.data_folder)
     p.add_argument("--no_download", dest="download", action="store_false",
                    default=True, help="never fetch CIFAR over the network")

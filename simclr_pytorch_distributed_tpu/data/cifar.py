@@ -51,12 +51,18 @@ def _md5(path: str) -> str:
     return h.hexdigest()
 
 
+DOWNLOAD_ATTEMPTS = 3
+DOWNLOAD_BACKOFF_BASE = 0.5  # seconds; doubles per attempt, plus jitter
+
+
 def download_cifar(
     dataset: str,
     data_folder: str,
     base_url: Optional[str] = None,
     md5: Optional[str] = None,
     timeout: float = 60.0,
+    attempts: int = DOWNLOAD_ATTEMPTS,
+    backoff_base: float = DOWNLOAD_BACKOFF_BASE,
 ) -> str:
     """Fetch + verify + extract a CIFAR archive; returns the marker dir.
 
@@ -65,7 +71,16 @@ def download_cifar(
     otherwise requires pre-placed binaries). Idempotent: an already-extracted
     marker dir or an already-downloaded md5-verified archive short-circuits.
     ``base_url``/``md5`` exist so tests can point at a local HTTP server.
+
+    The fetch itself retries ``attempts`` times with exponential backoff plus
+    jitter: a multi-host launch funnels through ONE downloader holding the
+    per-filesystem flock (``ensure_dataset_available``), so a transient HTTP
+    hiccup there would otherwise abort every host at once. An md5 mismatch
+    retries too — it is usually a truncated transfer, and each attempt
+    re-fetches into a fresh temp file.
     """
+    import random
+    import time
     import urllib.request
 
     if dataset not in CIFAR_ARCHIVES:
@@ -85,19 +100,34 @@ def download_cifar(
         # break, ensure_dataset_available) never share an inode; the winner's
         # os.replace is atomic either way
         tmp = archive + f".partial.{os.getpid()}"
-        try:
-            with urllib.request.urlopen(url, timeout=timeout) as r, open(tmp, "wb") as f:
-                shutil.copyfileobj(r, f)
-            got = _md5(tmp)
-            if got != want_md5:
-                raise ValueError(
-                    f"md5 mismatch for {url}: got {got}, want {want_md5}"
+        for attempt in range(1, max(1, attempts) + 1):
+            try:
+                with urllib.request.urlopen(url, timeout=timeout) as r, \
+                        open(tmp, "wb") as f:
+                    shutil.copyfileobj(r, f)
+                got = _md5(tmp)
+                if got != want_md5:
+                    raise ValueError(
+                        f"md5 mismatch for {url}: got {got}, want {want_md5}"
+                    )
+                os.replace(tmp, archive)  # atomic: no torn archive on the hit path
+                break
+            except Exception as e:  # noqa: BLE001 — URLError/timeout/md5/...
+                if attempt >= max(1, attempts):
+                    raise
+                delay = backoff_base * (2 ** (attempt - 1))
+                delay += random.uniform(0, delay / 2)  # jitter: desync waiters
+                import logging
+
+                logging.warning(
+                    "download attempt %d/%d for %s failed (%s); retrying "
+                    "in %.1fs", attempt, attempts, url, e, delay,
                 )
-            os.replace(tmp, archive)  # atomic: no torn archive on the hit path
-        finally:
-            # failed/aborted transfer: do not orphan a pid-unique partial
-            if os.path.exists(tmp):
-                os.remove(tmp)
+                time.sleep(delay)
+            finally:
+                # failed/aborted transfer: do not orphan a pid-unique partial
+                if os.path.exists(tmp):
+                    os.remove(tmp)
 
     if os.path.isdir(marker_dir):
         # a concurrent caller finished the extraction while we were fetching

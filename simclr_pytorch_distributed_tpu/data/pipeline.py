@@ -103,23 +103,38 @@ class EpochLoader:
             return np.random.default_rng(self.base_seed + epoch).permutation(n)
         return np.arange(n)
 
-    def _batches(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    def _batches(
+        self, epoch: int, start_step: int = 0
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         order = self._epoch_order(epoch)
         per_proc = self.global_batch_size // self.process_count
         lo = self.process_index * per_proc
-        for step in range(self.steps_per_epoch):
+        for step in range(start_step, self.steps_per_epoch):
             sel = order[step * self.global_batch_size:(step + 1) * self.global_batch_size]
             sel = sel[lo:lo + per_proc]
             yield _gather(self.images, self.labels, sel)
 
-    def epoch(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    def epoch(
+        self, epoch: int, start_step: int = 0
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         """One pass; ``epoch`` seeds the shuffle (sampler.set_epoch equivalent).
+
+        ``start_step`` skips the first batches of the epoch's DETERMINISTIC
+        permutation — the mid-epoch resume path (utils/preempt.py): a
+        checkpoint recording ``step_in_epoch = k`` restarts with
+        ``epoch(e, start_step=k)`` and consumes exactly the batches the
+        interrupted run never saw, in the same order.
 
         With ``prefetch > 0``, batch assembly runs in a daemon thread so the
         native gather for step k+1 overlaps the device step for batch k.
         """
+        if not 0 <= start_step < self.steps_per_epoch:
+            raise ValueError(
+                f"start_step {start_step} outside [0, {self.steps_per_epoch})"
+                f" — the driver must roll a full-epoch offset into `epoch`"
+            )
         if self.prefetch <= 0:
-            yield from self._batches(epoch)
+            yield from self._batches(epoch, start_step)
             return
 
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
@@ -131,7 +146,7 @@ class EpochLoader:
             # thread, where it can abort the step (and, multi-host, the job)
             # with a real traceback instead of a collective timeout.
             try:
-                for item in self._batches(epoch):
+                for item in self._batches(epoch, start_step):
                     q.put(item)
             except BaseException as e:  # noqa: BLE001 — forwarded, not handled
                 q.put(e)

@@ -57,7 +57,9 @@ def ring_supcon_loss(
       Per-device mean anchor loss pmean-ed over the axis == the global loss.
     """
     m, _ = feats_local.shape
-    p = jax.lax.axis_size(axis_name)
+    from simclr_pytorch_distributed_tpu.compat import axis_size
+
+    p = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     rows_total = m * p  # V*B
     batch = rows_total // n_views
@@ -100,10 +102,11 @@ def ring_supcon_loss(
         return (block, new_max, run_sum, pos_acc, pos_cnt), None
 
     def dev_varying(x):
-        # mark fresh accumulators as device-varying for shard_map's vma typing
-        if hasattr(jax.lax, "pcast"):
-            return jax.lax.pcast(x, (axis_name,), to="varying")
-        return jax.lax.pvary(x, (axis_name,))  # older jax
+        # mark fresh accumulators as device-varying for shard_map's vma
+        # typing (identity on pre-vma jax, compat.pvary)
+        from simclr_pytorch_distributed_tpu.compat import pvary
+
+        return pvary(x, (axis_name,))
 
     init = (
         feats_local,

@@ -46,7 +46,11 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
 )
 from simclr_pytorch_distributed_tpu.train.linear import run_validation, stats_for, topk_correct
 from simclr_pytorch_distributed_tpu.train.supcon import enable_compile_cache
+from simclr_pytorch_distributed_tpu.utils import preempt
 from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+    resolve_resume_path,
+    restore_checkpoint,
+    resume_position,
     save_checkpoint,
     wait_for_saves,
 )
@@ -172,62 +176,119 @@ def run(cfg: config_lib.LinearConfig):
     aug_cfg = AugmentConfig(size=cfg.size, mean=mean, std=std, color_ops=False)
     train_jit, eval_jit = make_ce_steps(model, tx, aug_cfg, mesh)
 
+    start_epoch, start_step = 1, 0
+    meta = {}
+    if getattr(cfg, "resume", ""):
+        # full-state resume, step-granular like the pretrain driver's: the
+        # restore goes through the TrainState facade state_for_save already
+        # defines for the saver, then maps back onto CEState.
+        resume_path = resolve_resume_path(cfg.resume)
+        restored, meta = restore_checkpoint(resume_path, state_for_save(state))
+        state = CEState(
+            step=restored.step, params=restored.params,
+            batch_stats=restored.batch_stats, opt_state=restored.opt_state,
+        )
+        start_epoch, start_step = resume_position(meta, steps_per_epoch)
+        logging.info(
+            "resumed from %s at epoch %d step %d",
+            resume_path, start_epoch, start_step,
+        )
+
     tb = TBLogger(cfg.tb_folder, enabled=is_main_process())
     base_key = jax.random.key(cfg.seed + 1)
-    best_acc, best_acc5 = 0.0, 0.0
+    # the best-accuracy watermark is RUN state: a resumed run that never
+    # re-beats the pre-preemption peak must still report it (checkpoint
+    # meta carries it, like the pretrain driver's rollback damping)
+    best_acc = float(meta.get("best_acc") or 0.0)
+    best_acc5 = float(meta.get("best_acc5") or 0.0)
+
+    def run_meta():
+        return {"best_acc": best_acc, "best_acc5": best_acc5}
 
     def eval_variables(state):
         return {"params": state.params, "batch_stats": state.batch_stats}
 
-    for epoch in range(1, cfg.epochs + 1):
-        t1 = time.time()
-        losses, top1 = AverageMeter(), AverageMeter()
-        buffer = MetricBuffer()
+    preempt.install()
+    try:
+        for epoch in range(start_epoch, cfg.epochs + 1):
+            t1 = time.time()
+            losses, top1 = AverageMeter(), AverageMeter()
+            buffer = MetricBuffer()
 
-        def fold_metrics():
-            # one batched readback; every step reaches the meters
-            for _, m in buffer.flush():
-                losses.update(m["loss"], cfg.batch_size)
-                top1.update(100.0 * m["top1"] / cfg.batch_size, cfg.batch_size)
+            def fold_metrics():
+                # one batched readback; every step reaches the meters
+                for _, m in buffer.flush():
+                    losses.update(m["loss"], cfg.batch_size)
+                    top1.update(100.0 * m["top1"] / cfg.batch_size, cfg.batch_size)
 
-        for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
-            batch = shard_host_batch((images_u8, labels), mesh)
-            state, m = train_jit(state, batch[0], batch[1], base_key)
-            buffer.append(idx, m)
-            if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
-                fold_metrics()
-                logging.info(
-                    "Train: [%d][%d/%d]\tloss %.3f (%.3f)\tAcc@1 %.3f (%.3f)",
-                    epoch, idx + 1, steps_per_epoch,
-                    losses.val, losses.avg, top1.val, top1.avg,
-                )
-        fold_metrics()
-        logging.info("Train epoch %d, total time %.2f, accuracy:%.2f",
-                     epoch, time.time() - t1, top1.avg)
+            ss = start_step if epoch == start_epoch else 0
+            for idx, (images_u8, labels) in enumerate(
+                loader.epoch(epoch, start_step=ss), start=ss
+            ):
+                batch = shard_host_batch((images_u8, labels), mesh)
+                state, m = train_jit(state, batch[0], batch[1], base_key)
+                buffer.append(idx, m)
+                if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
+                    fold_metrics()
+                    logging.info(
+                        "Train: [%d][%d/%d]\tloss %.3f (%.3f)\tAcc@1 %.3f (%.3f)",
+                        epoch, idx + 1, steps_per_epoch,
+                        losses.val, losses.avg, top1.val, top1.avg,
+                    )
+                    if idx + 1 < steps_per_epoch and preempt.requested_global():
+                        # SIGTERM/SIGINT at a flush boundary, decided
+                        # collectively (see train/supcon.py): metrics are
+                        # drained; emergency mid-epoch save (collective, same
+                        # semantics as the pretrain driver) and the distinct
+                        # exit code tell the launcher to re-run with --resume.
+                        preempt.emergency_save_and_exit(
+                            cfg.save_folder,
+                            f"preempt_epoch_{epoch}_step_{idx + 1}",
+                            state_for_save(state),
+                            config_lib.config_dict(cfg), epoch - 1,
+                            step_in_epoch=idx + 1, extra_meta=run_meta(),
+                            cleanup=(tb.close,),
+                        )
+            fold_metrics()
+            logging.info("Train epoch %d, total time %.2f, accuracy:%.2f",
+                         epoch, time.time() - t1, top1.avg)
 
-        val = run_validation(
-            eval_jit, eval_variables(state), test_data["images"],
-            test_data["labels"], cfg.val_batch_size, mesh,
-        )
-        logging.info(" * Acc@1 %.3f, Acc@5 %.3f", val["top1"], val["top5"])
-        if is_main_process():
-            tb.log_value("ce/train_loss", losses.avg, epoch)
-            tb.log_value("ce/train_acc1", top1.avg, epoch)
-            tb.log_value("ce/val_loss", val["loss"], epoch)
-            tb.log_value("ce/val_acc1", val["top1"], epoch)
-            tb.log_value("ce/val_acc5", val["top5"], epoch)
-        if val["top1"] > best_acc:
-            best_acc, best_acc5 = val["top1"], val["top5"]
-        if epoch % cfg.save_freq == 0:
-            # collective on all processes (orbax coordinates writers;
-            # meta.json stays process-0-gated inside save_checkpoint)
-            save_checkpoint(
-                cfg.save_folder, f"ckpt_epoch_{epoch}",
-                # CEState quacks enough like TrainState for the saver
-                state_for_save(state), config=config_lib.config_dict(cfg),
-                epoch=epoch, block=False,
+            val = run_validation(
+                eval_jit, eval_variables(state), test_data["images"],
+                test_data["labels"], cfg.val_batch_size, mesh,
             )
+            logging.info(" * Acc@1 %.3f, Acc@5 %.3f", val["top1"], val["top5"])
+            if is_main_process():
+                tb.log_value("ce/train_loss", losses.avg, epoch)
+                tb.log_value("ce/train_acc1", top1.avg, epoch)
+                tb.log_value("ce/val_loss", val["loss"], epoch)
+                tb.log_value("ce/val_acc1", val["top1"], epoch)
+                tb.log_value("ce/val_acc5", val["top5"], epoch)
+            if val["top1"] > best_acc:
+                best_acc, best_acc5 = val["top1"], val["top5"]
+            if epoch % cfg.save_freq == 0:
+                # collective on all processes (orbax coordinates writers;
+                # meta.json stays process-0-gated inside save_checkpoint)
+                save_checkpoint(
+                    cfg.save_folder, f"ckpt_epoch_{epoch}",
+                    # CEState quacks enough like TrainState for the saver
+                    state_for_save(state), config=config_lib.config_dict(cfg),
+                    epoch=epoch, block=False, extra_meta=run_meta(),
+                )
+            if preempt.requested_global():
+                # boundary preemption (collective decision): this epoch is
+                # persisted (by the scheduled save above, or a preempt_*
+                # save now), then the distinct exit
+                preempt.emergency_save_and_exit(
+                    cfg.save_folder,
+                    None if epoch % cfg.save_freq == 0
+                    else f"preempt_epoch_{epoch}",
+                    state_for_save(state), config_lib.config_dict(cfg),
+                    epoch, extra_meta=run_meta(), cleanup=(tb.close,),
+                )
 
+    finally:
+        preempt.uninstall()
     wait_for_saves()
     logging.info("best accuracy: %.2f, accuracy5: %.2f", best_acc, best_acc5)
     tb.close()

@@ -62,6 +62,7 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
 )
 from simclr_pytorch_distributed_tpu.train.state import make_optimizer
 from simclr_pytorch_distributed_tpu.train.supcon import enable_compile_cache
+from simclr_pytorch_distributed_tpu.utils import preempt
 from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     load_pretrained_variables,
     save_classifier,
@@ -254,57 +255,85 @@ def run(cfg: config_lib.LinearConfig):
     best_acc, best_acc5 = 0.0, 0.0
     best_params = None
 
-    for epoch in range(1, cfg.epochs + 1):
-        t1 = time.time()
-        losses, top1, top5 = AverageMeter(), AverageMeter(), AverageMeter()
-        bt = AverageMeter()
-        buffer = MetricBuffer()
-        bsz = cfg.batch_size
+    # The probe has no full-state checkpoints to resume (epochs are seconds,
+    # not hours), but it still honors the fleet's SIGTERM contract: finish
+    # the flush window, persist the best classifier so far, exit with the
+    # preemption code so the launcher knows no re-run bookkeeping is lost.
+    # The launcher's blanket "re-run with --resume" relaunch is accepted
+    # (config.linear_parser) and means: retrain from scratch.
+    if getattr(cfg, "resume", ""):
+        logging.warning(
+            "--resume %s: the probe keeps no full-state checkpoints; "
+            "retraining from scratch", cfg.resume,
+        )
+    preempt.install()
+    preempted = False
+    try:
+        for epoch in range(1, cfg.epochs + 1):
+            t1 = time.time()
+            losses, top1, top5 = AverageMeter(), AverageMeter(), AverageMeter()
+            bt = AverageMeter()
+            buffer = MetricBuffer()
+            bsz = cfg.batch_size
 
-        def fold_metrics():
-            # one batched readback; every step reaches the meters
-            for _, m in buffer.flush():
-                losses.update(m["loss"], bsz)
-                top1.update(100.0 * m["top1"] / bsz, bsz)
-                top5.update(100.0 * m["top5"] / bsz, bsz)
+            def fold_metrics():
+                # one batched readback; every step reaches the meters
+                for _, m in buffer.flush():
+                    losses.update(m["loss"], bsz)
+                    top1.update(100.0 * m["top1"] / bsz, bsz)
+                    top5.update(100.0 * m["top5"] / bsz, bsz)
 
-        end = time.time()
-        for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
-            batch = shard_host_batch((images_u8, labels), mesh)
-            state, m = train_jit(state, batch[0], batch[1], base_key)
-            buffer.append(idx, m)
-            if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
-                fold_metrics()
-                bt.update(time.time() - end)
-                logging.info(
-                    "Train: [%d][%d/%d]\tBT %.3f (%.3f)\tloss %.3f (%.3f)\t"
-                    "Acc@1 %.3f (%.3f)",
-                    epoch, idx + 1, steps_per_epoch, bt.val, bt.avg,
-                    losses.val, losses.avg, top1.val, top1.avg,
-                )
             end = time.time()
-        fold_metrics()
-        logging.info(
-            "Train epoch %d, total time %.2f, accuracy:%.2f",
-            epoch, time.time() - t1, top1.avg,
-        )
-        if is_main_process():
-            tb.log_value("classifier/train_loss", losses.avg, epoch)
-            tb.log_value("classifier/train_acc1", top1.avg, epoch)
-            tb.log_value("classifier/train_acc5", top5.avg, epoch)
+            for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
+                batch = shard_host_batch((images_u8, labels), mesh)
+                state, m = train_jit(state, batch[0], batch[1], base_key)
+                buffer.append(idx, m)
+                if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
+                    fold_metrics()
+                    bt.update(time.time() - end)
+                    logging.info(
+                        "Train: [%d][%d/%d]\tBT %.3f (%.3f)\tloss %.3f (%.3f)\t"
+                        "Acc@1 %.3f (%.3f)",
+                        epoch, idx + 1, steps_per_epoch, bt.val, bt.avg,
+                        losses.val, losses.avg, top1.val, top1.avg,
+                    )
+                    if preempt.requested_global():
+                        # collective decision (see train/supcon.py): all
+                        # hosts leave the loop at the same flush boundary,
+                        # keeping the end-of-run barriers matched
+                        preempted = True
+                        break
+                end = time.time()
+            fold_metrics()
+            if preempted:
+                logging.warning(
+                    "preempted (%s) during epoch %d: stopping the probe",
+                    preempt.signal_name(), epoch,
+                )
+                break
+            logging.info(
+                "Train epoch %d, total time %.2f, accuracy:%.2f",
+                epoch, time.time() - t1, top1.avg,
+            )
+            if is_main_process():
+                tb.log_value("classifier/train_loss", losses.avg, epoch)
+                tb.log_value("classifier/train_acc1", top1.avg, epoch)
+                tb.log_value("classifier/train_acc5", top5.avg, epoch)
 
-        val = run_validation(
-            eval_jit, state.params, test_data["images"], test_data["labels"],
-            cfg.val_batch_size, mesh,
-        )
-        logging.info(" * Acc@1 %.3f, Acc@5 %.3f", val["top1"], val["top5"])
-        if is_main_process():
-            tb.log_value("classifier/val_loss", val["loss"], epoch)
-            tb.log_value("classifier/val_acc1", val["top1"], epoch)
-            tb.log_value("classifier/val_acc5", val["top5"], epoch)
-        if val["top1"] > best_acc:
-            best_acc, best_acc5 = val["top1"], val["top5"]
-            best_params = jax.device_get(state.params)
+            val = run_validation(
+                eval_jit, state.params, test_data["images"], test_data["labels"],
+                cfg.val_batch_size, mesh,
+            )
+            logging.info(" * Acc@1 %.3f, Acc@5 %.3f", val["top1"], val["top5"])
+            if is_main_process():
+                tb.log_value("classifier/val_loss", val["loss"], epoch)
+                tb.log_value("classifier/val_acc1", val["top1"], epoch)
+                tb.log_value("classifier/val_acc5", val["top5"], epoch)
+            if val["top1"] > best_acc:
+                best_acc, best_acc5 = val["top1"], val["top5"]
+                best_params = jax.device_get(state.params)
+    finally:
+        preempt.uninstall()
 
     if best_params is not None:
         # beyond parity: persist the best probe head (the reference only
@@ -313,6 +342,9 @@ def run(cfg: config_lib.LinearConfig):
         logging.info("saved best classifier to %s", path)
     logging.info("best accuracy: %.2f, accuracy5: %.2f", best_acc, best_acc5)
     tb.close()
+    if preempted:
+        sync_processes("linear_run_preempted")
+        raise SystemExit(preempt.EXIT_PREEMPTED)
     sync_processes("linear_run_end")
     return best_acc, best_acc5
 

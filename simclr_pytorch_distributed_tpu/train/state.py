@@ -71,6 +71,30 @@ def make_optimizer(
     return optax.chain(*parts)
 
 
+def realign_schedule_count(opt_state, step: int):
+    """Set every ``ScaleByScheduleState.count`` inside ``opt_state`` to
+    ``step``.
+
+    The applied LR is ``schedule(opt_state.count)``, NOT ``schedule
+    (state.step)`` — the two advance in lockstep normally, but any manual
+    step jump (the NaN-rollback epoch skip, train/supcon.py) must move BOTH,
+    or training silently runs the schedule an epoch behind the position the
+    logs report. Works for both optimizer chains (sgd and lars place the
+    state at different chain indexes) and is a no-op for constant-LR chains
+    (no schedule state to find).
+    """
+    is_sched = lambda s: isinstance(s, optax.ScaleByScheduleState)  # noqa: E731
+
+    def fix(s):
+        if is_sched(s):
+            # derive from the existing count: keeps dtype AND the
+            # mesh-replicated sharding a fresh scalar would lack
+            return s._replace(count=(s.count * 0 + step).astype(s.count.dtype))
+        return s
+
+    return jax.tree.map(fix, opt_state, is_leaf=is_sched)
+
+
 def create_train_state(
     model,
     tx: optax.GradientTransformation,

@@ -54,19 +54,24 @@ from simclr_pytorch_distributed_tpu.train.state import (
     TrainState,
     create_train_state,
     make_optimizer,
+    realign_schedule_count,
 )
 from simclr_pytorch_distributed_tpu.train.supcon_step import (
     SupConStepConfig,
     make_train_step,
 )
 from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+    jit_copy_tree,
     load_pretrained_variables,
     resolve_resume_path,
     restore_checkpoint,
+    resume_position,
     save_checkpoint,
     wait_for_saves,
 )
+from simclr_pytorch_distributed_tpu.utils import preempt
 from simclr_pytorch_distributed_tpu.utils.guard import (
+    FailurePolicy,
     NonFiniteLossError,
     check_finite_loss,
 )
@@ -191,7 +196,7 @@ TB_ITER_SCALARS = (  # reference per-iter scalars, main_supcon.py:327-333
 
 def train_one_epoch(
     epoch, loader, update_fn, state, mesh, base_key, cfg, tb, steps_per_epoch,
-    tracer=None,
+    tracer=None, start_step=0,
 ):
     """One epoch (reference train(), main_supcon.py:242-351).
 
@@ -201,6 +206,20 @@ def train_one_epoch(
     semantics — ``info/*`` TB scalars every iteration (main_supcon.py:327-333)
     and a loss meter averaging ALL steps (main_supcon.py:320) — without the
     reference's per-iter ``.item()`` sync point.
+
+    ``start_step > 0`` is the mid-epoch resume path: the loader skips the
+    already-consumed prefix of the epoch's deterministic permutation and the
+    step indices continue from where the preempted run stopped (``state.step``
+    was restored from the checkpoint, so the in-program per-step PRNG keys
+    line up with the uninterrupted run).
+
+    Each flush boundary also checks the preemption flag (utils/preempt.py):
+    metrics are already drained at that point, so on SIGTERM/SIGINT this
+    returns early and :func:`run` writes the emergency mid-epoch checkpoint.
+
+    Returns ``(state, loss_avg, last_metrics, preempted_at)`` where
+    ``preempted_at`` is the number of epoch steps completed when preemption
+    was observed, or ``None`` for a full epoch.
     """
     batch_time, data_time, losses = AverageMeter(), AverageMeter(), AverageMeter()
     end = time.time()
@@ -224,7 +243,10 @@ def train_one_epoch(
             check_finite_loss(m["loss"], gstep_f, cfg.nan_guard)
             losses.update(m["loss"], bsz)
             if is_main_process() and tb is not None:
-                it = epoch * steps_per_epoch + idx_f
+                # the TRUE global step — same coordinate as the tracer, the
+                # checkpoint meta, and the preemption/rollback log lines, so
+                # a failure event correlates directly against the curves
+                it = (epoch - 1) * steps_per_epoch + idx_f
                 for name in TB_ITER_SCALARS:
                     tb.log_value(f"info/{name}", m[name], it)
             last_host = m
@@ -233,7 +255,9 @@ def train_one_epoch(
             batch_time.update(per_step, n=len(fetched))
         window_start = time.time()
 
-    for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
+    for idx, (images_u8, labels) in enumerate(
+        loader.epoch(epoch, start_step=start_step), start=start_step
+    ):
         data_time.update(time.time() - end)
         global_step = (epoch - 1) * steps_per_epoch + idx
         batch = shard_host_batch((images_u8, labels), mesh)
@@ -254,10 +278,22 @@ def train_one_epoch(
                 last_host["norm_mean"], last_host["record_norm_mean"],
                 last_host["norm_var"],
             )
+            if idx + 1 < steps_per_epoch and preempt.requested_global():
+                # collective decision — every process calls requested_global
+                # at this same deterministic boundary, so all hosts commit
+                # to the same preemption step (a lone-host observation would
+                # deadlock the collective save against peers' train steps).
+                # Metrics are drained (the flush above); hand the mid-epoch
+                # state back so run() can emergency-checkpoint it. The
+                # last-step boundary falls through instead — that preemption
+                # is an ordinary epoch-boundary save.
+                loss_avg = losses.avg if losses.count else last_host.get("loss", 0.0)
+                return state, loss_avg, last_host, idx + 1
         end = time.time()
 
     flush()
-    return state, losses.avg if losses.count else last_host.get("loss", 0.0), last_host
+    loss_avg = losses.avg if losses.count else last_host.get("loss", 0.0)
+    return state, loss_avg, last_host, None
 
 
 def enable_compile_cache(compile_cache: str, workdir: str) -> None:
@@ -303,7 +339,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
     model, schedule, tx, state, step_cfg = build(cfg, steps_per_epoch, mesh.size)
     logging.info("contrastive loss impl: %s", step_cfg.loss_impl)
 
-    start_epoch = 1
+    start_epoch, start_step = 1, 0
     if cfg.ckpt:
         # warm start: model variables only (main_supcon.py:216-220)
         variables = load_pretrained_variables(
@@ -313,14 +349,59 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
             params=variables["params"], batch_stats=variables["batch_stats"]
         )
         logging.info("load model from %s ...", cfg.ckpt)
+    meta = {}
     if cfg.resume:
         resume_path = resolve_resume_path(cfg.resume)
         state, meta = restore_checkpoint(resume_path, state)
-        start_epoch = int(meta.get("epoch", 0)) + 1
-        logging.info("resumed from %s at epoch %d", resume_path, start_epoch)
+        # mid-epoch emergency save (utils/preempt.py): re-enter the epoch at
+        # the first unconsumed batch of its deterministic permutation
+        start_epoch, start_step = resume_position(meta, steps_per_epoch)
+        logging.info(
+            "resumed from %s at epoch %d step %d",
+            resume_path, start_epoch, start_step,
+        )
 
     aug_cfg = make_augment_config(cfg)
-    update_fn = make_fused_update(model, tx, schedule, step_cfg, aug_cfg, mesh, state)
+
+    def build_update(lr_scale: float):
+        """The fused jitted update; ``lr_scale != 1`` (the NaN-rollback
+        damping) rescales the whole schedule — optimizer chain structure is
+        unchanged, so existing opt_states restore into it directly."""
+        if lr_scale == 1.0:
+            return make_fused_update(
+                model, tx, schedule, step_cfg, aug_cfg, mesh, state
+            )
+        scaled = lambda s, sc=lr_scale: schedule(s) * sc  # noqa: E731
+        return make_fused_update(
+            model,
+            make_optimizer(
+                scaled, momentum=cfg.momentum,
+                weight_decay=cfg.weight_decay, optimizer=cfg.optimizer,
+            ),
+            scaled, step_cfg, aug_cfg, mesh, state,
+        )
+
+    # failure policy (utils/guard.py): what a NonFiniteLossError does to the
+    # run. Rollback damping is RUN state, not config — it rides checkpoint
+    # meta (extra_meta below) so a preempted/crashed run resumes at the
+    # damped LR with its rollback budget intact, instead of silently
+    # reverting to the LR that NaN'd in the first place.
+    policy = FailurePolicy(cfg.nan_policy)
+    try:
+        policy.lr_scale = float(meta.get("lr_scale") or 1.0)
+        policy.rollbacks = int(meta.get("rollbacks") or 0)
+    except (TypeError, ValueError):
+        pass  # hand-edited meta: keep the fresh policy
+    if policy.lr_scale != 1.0:
+        logging.warning(
+            "resumed with rollback damping: lr_scale %.3g after %d "
+            "rollbacks", policy.lr_scale, policy.rollbacks,
+        )
+
+    def policy_meta():
+        return {"lr_scale": policy.lr_scale, "rollbacks": policy.rollbacks}
+
+    update_fn = build_update(policy.lr_scale)
     tb = TBLogger(cfg.tb_folder, enabled=is_main_process())
     base_key = jax.random.key(cfg.seed + 1)
     tracer = StepTracer(
@@ -333,41 +414,88 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
     # programs whose caches all miss AGAIN at epoch 2 (the post-update state
     # carries mesh shardings the fresh epoch-1 state lacked), costing ~20 s
     # of sub-second compiles that the persistent cache never keeps. One
-    # program = one compile per sharding layout, persisted across runs.
-    copy_state = jax.jit(lambda s: jax.tree.map(jnp.copy, s))
+    # program = one compile per sharding layout, persisted across runs —
+    # shared with the restore path's buffer re-owning copy.
+    copy_state = jit_copy_tree
 
+    # NOTE on preemption in multi-process jobs: the decision to stop is
+    # collective (preempt.requested_global), so the emergency save below
+    # sees all processes arrive (docs/RESILIENCE.md).
+    preempt.install()
     try:
         for epoch in range(start_epoch, cfg.epochs + 1):
             t1 = time.time()
+            ss = start_step if epoch == start_epoch else 0
             # The update donates the incoming state's buffers, so the pre-epoch
             # `state` object is DELETED after the first step — an un-donated
             # on-device copy (one HBM->HBM copy per epoch) is what the crash
             # handler can still save.
             backup = copy_state(state) if cfg.nan_guard else None
             try:
-                state, loss_avg, metrics = train_one_epoch(
+                state, loss_avg, metrics, preempted_at = train_one_epoch(
                     epoch, loader, update_fn, state, mesh, base_key, cfg, tb,
-                    steps_per_epoch, tracer=tracer,
+                    steps_per_epoch, tracer=tracer, start_step=ss,
                 )
             except NonFiniteLossError:
-                # emergency save of the last epoch-boundary state so --resume
-                # can restart after the root cause is addressed (failure
-                # detection, SURVEY.md §5 — absent upstream). NOTE: orbax
-                # multi-process saves are collective — EVERY process calls
-                # save_checkpoint (orbax coordinates who writes; meta.json is
-                # process-0-gated inside); only logging stays process-0.
+                # emergency save of the epoch-top state so --resume can
+                # restart after the root cause is addressed (failure
+                # detection, SURVEY.md §5 — absent upstream). step_in_epoch
+                # = ss: after a mid-epoch resume the backup sits mid-epoch,
+                # and a resume from this save must not replay consumed
+                # batches. NOTE: orbax multi-process saves are collective —
+                # EVERY process calls save_checkpoint (orbax coordinates who
+                # writes; meta.json is process-0-gated inside); only logging
+                # stays process-0.
                 save_checkpoint(
                     cfg.save_folder, f"crash_epoch_{epoch}", backup,
                     config=config_lib.config_dict(cfg), epoch=epoch - 1,
+                    step_in_epoch=ss, extra_meta=policy_meta(),
                 )
                 if is_main_process():
                     logging.error("non-finite loss: saved crash_epoch_%d", epoch)
-                raise
+                if not policy.should_rollback():
+                    raise
+                # --nan_policy rollback: restore the epoch-boundary backup,
+                # SKIP the poisoned epoch (the step counter jumps to this
+                # epoch's end so the LR schedule position and the per-step
+                # PRNG stream stay aligned with the epoch number), damp the
+                # LR, and keep training. The applied LR reads the
+                # optimizer's OWN ScaleByScheduleState counter, so the jump
+                # must realign that too — not just state.step — or the
+                # schedule silently lags the skip.
+                target = epoch * steps_per_epoch
+                state = backup.replace(
+                    step=backup.step + (target - int(backup.step)),
+                    opt_state=realign_schedule_count(backup.opt_state, target),
+                )
+                update_fn = build_update(policy.lr_scale)
+                logging.warning(
+                    "nan_policy=rollback (%d/%d): epoch %d skipped from its "
+                    "boundary backup, lr scaled to %.3g",
+                    policy.rollbacks, policy.max_rollbacks, epoch,
+                    policy.lr_scale,
+                )
+                continue
+            if preempted_at is not None:
+                # SIGTERM/SIGINT observed (collectively) at a flush boundary
+                # mid-epoch: blocking emergency save carrying the intra-epoch
+                # position, then the distinct exit code. run()'s finally
+                # still drains/uninstalls/closes on the way out.
+                preempt.emergency_save_and_exit(
+                    cfg.save_folder,
+                    f"preempt_epoch_{epoch}_step_{preempted_at}", state,
+                    config_lib.config_dict(cfg), epoch - 1,
+                    step_in_epoch=preempted_at, extra_meta=policy_meta(),
+                )
             t2 = time.time()
             logging.info("epoch %d, total time %.2f", epoch, t2 - t1)
             if is_main_process():
                 tb.log_value("loss", loss_avg, epoch)
-                tb.log_value("learning_rate", float(schedule((epoch - 1) * steps_per_epoch)), epoch)
+                tb.log_value(
+                    "learning_rate",
+                    float(schedule((epoch - 1) * steps_per_epoch)) * policy.lr_scale,
+                    epoch,
+                )
             if epoch % cfg.save_freq == 0:
                 # collective on all processes (see crash handler note); async
                 # write: D2H serialization is synchronous (safe with buffer
@@ -375,16 +503,32 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
                 save_checkpoint(
                     cfg.save_folder, f"ckpt_epoch_{epoch}", state,
                     config=config_lib.config_dict(cfg), epoch=epoch, block=False,
+                    extra_meta=policy_meta(),
+                )
+            if preempt.requested_global():
+                # epoch-boundary preemption (the signal landed in the last
+                # flush window), decided collectively like the mid-epoch
+                # check: persist this epoch unless the scheduled save above
+                # already did (name=None skips the write but still drains
+                # the async save so its meta stamps), then exit.
+                preempt.emergency_save_and_exit(
+                    cfg.save_folder,
+                    None if epoch % cfg.save_freq == 0
+                    else f"preempt_epoch_{epoch}",
+                    state, config_lib.config_dict(cfg), epoch,
+                    extra_meta=policy_meta(),
                 )
         wait_for_saves()
         save_checkpoint(
             cfg.save_folder, "last", state,
             config=config_lib.config_dict(cfg), epoch=cfg.epochs,
+            extra_meta=policy_meta(),
         )
     finally:
         # On failure too: stop/flush an active profiler trace (it is most
         # valuable exactly when the epoch loop died) and drain in-flight
         # async checkpoint writes so finished payloads get their meta stamp.
+        preempt.uninstall()
         tracer.close()
         tb.close()
         wait_for_saves()

@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from jax import shard_map
+from simclr_pytorch_distributed_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from simclr_pytorch_distributed_tpu.ops.losses import supcon_loss

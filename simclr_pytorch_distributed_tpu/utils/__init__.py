@@ -1,10 +1,13 @@
+from simclr_pytorch_distributed_tpu.utils import preempt  # noqa: F401
 from simclr_pytorch_distributed_tpu.utils.checkpoint import (  # noqa: F401
     load_pretrained_variables,
+    resolve_resume_path,
     restore_checkpoint,
     save_checkpoint,
     wait_for_saves,
 )
 from simclr_pytorch_distributed_tpu.utils.guard import (  # noqa: F401
+    FailurePolicy,
     NonFiniteLossError,
     check_finite_loss,
 )

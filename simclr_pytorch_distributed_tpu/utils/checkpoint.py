@@ -28,6 +28,7 @@ import os
 from typing import Any, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
 from simclr_pytorch_distributed_tpu.parallel.mesh import is_main_process
@@ -52,6 +53,48 @@ _PENDING: List[Tuple[List[ocp.StandardCheckpointer], str, dict]] = []
 
 def _abstract(tree):
     return jax.tree.map(ocp.utils.to_shape_dtype_struct, tree)
+
+
+# One jitted whole-tree copy, shared by every consumer (restore re-owning
+# below, the drivers' per-epoch crash backup): a single jit object means one
+# trace cache per tree structure/sharding, and one program per dispatch
+# instead of ~30 op-by-op jit(copy) cache misses (see train/supcon.py's
+# epoch-backup note for the measured cost of the op-by-op version).
+jit_copy_tree = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+
+
+def resume_position(meta: dict, steps_per_epoch: int) -> Tuple[int, int]:
+    """Decode a checkpoint meta into ``(start_epoch, start_step)``.
+
+    ``epoch`` counts completed epochs; ``step_in_epoch`` counts consumed
+    steps of the next one (mid-epoch emergency saves). A recorded offset at
+    or past this run's ``steps_per_epoch`` means the config changed across
+    the resume (batch size / dataset) — the offset is meaningless, so warn
+    and degrade to the next epoch boundary.
+    """
+    import logging
+
+    start_epoch = int(meta.get("epoch", 0)) + 1
+    try:
+        start_step = int(meta.get("step_in_epoch") or 0)
+    except (TypeError, ValueError):
+        # hand-edited meta: resolve_resume_path tolerates this (treats it as
+        # an epoch boundary), so the resume must degrade the same way
+        # instead of crashing the driver
+        logging.warning(
+            "unparseable step_in_epoch %r in checkpoint meta; resuming at "
+            "the epoch boundary", meta.get("step_in_epoch"),
+        )
+        start_step = 0
+    if start_step >= steps_per_epoch:
+        logging.warning(
+            "checkpoint step_in_epoch %d >= %d steps/epoch (config changed "
+            "across resume?); starting at the next epoch",
+            start_step, steps_per_epoch,
+        )
+        start_epoch += 1
+        start_step = 0
+    return start_epoch, start_step
 
 
 def _save_tree(path: str, tree, block: bool = True):
@@ -104,17 +147,40 @@ def _restore_tree(path: str, abstract_tree):
 def save_checkpoint(
     save_folder: str, name: str, state, config: Optional[dict] = None,
     epoch: Optional[int] = None, block: bool = True,
+    step_in_epoch: int = 0, extra_meta: Optional[dict] = None,
 ) -> str:
     """Write ``{save_folder}/{name}`` (ckpt_epoch_N / last naming upstream).
 
     ``block=False`` overlaps the disk write with subsequent training (the
     reference's ``torch.save`` stalls the epoch loop); the driver drains
     pending writes via ``wait_for_saves()`` before the final save/exit.
+
+    ``epoch`` counts COMPLETED epochs; ``step_in_epoch`` counts steps of the
+    NEXT epoch (``epoch + 1``) already consumed — non-zero only for the
+    mid-epoch emergency saves a preemption triggers (utils/preempt.py). The
+    pair is the full dataset-position coordinate a resume needs: the epoch
+    shuffle is deterministic in ``base_seed + epoch`` and the per-step PRNG
+    key in ``state.step``, so resuming at (epoch+1, step_in_epoch) replays
+    the uninterrupted run bit-identically.
+
+    ``extra_meta`` carries driver-side run state that must survive a resume
+    but lives outside the jax state tree (the NaN-rollback LR damping, the
+    CE trainer's best-accuracy watermark); keys merge into meta.json beside
+    the reserved ones.
     """
     if not block:
         # bound resources to one in-flight save: the previous async write
         # (a save_freq of epochs ago) has long finished, so this is ~free
         wait_for_saves()
+        # Snapshot before handing off: the caller's buffers are DONATED to
+        # the very next train step while the background write may still be
+        # serializing them. On backends where device memory IS host memory
+        # (CPU) orbax can read the reused buffer and persist a torn state a
+        # few steps AHEAD of the recorded epoch — observed as a kill -9
+        # resume restarting from a mid-later-epoch step
+        # (tests/test_fault_injection.py). One on-device copy decouples the
+        # save from donation on every backend.
+        state = jit_copy_tree(state)
     path = os.path.abspath(os.path.join(save_folder, name))
     c1 = _save_tree(
         os.path.join(path, "model"),
@@ -131,7 +197,9 @@ def save_checkpoint(
         block=block,
     )
     meta = {
-        "epoch": epoch, "config": config or {},
+        **(extra_meta or {}),
+        "epoch": epoch, "step_in_epoch": int(step_in_epoch),
+        "config": config or {},
         "model_layout": MODEL_LAYOUT_VERSION,
     }
     if block:
@@ -145,10 +213,14 @@ def resolve_resume_path(path: str) -> str:
     """Accepts either one checkpoint dir or a RUN dir; returns a checkpoint.
 
     Passing a run folder (the timestamped directory holding ``ckpt_epoch_N``/
-    ``crash_epoch_N``/``last``) picks the COMPLETE checkpoint (meta.json
-    present) with the highest recorded epoch — so after a crash,
-    ``--resume <run_dir>`` does the right thing without the user inspecting
-    which save survived.
+    ``crash_epoch_N``/``preempt_*``/``last``) picks the COMPLETE checkpoint
+    (meta.json present AND parseable) with the most recorded progress —
+    ``(epoch, step_in_epoch)`` lexicographically, so a mid-epoch preemption
+    save beats the scheduled save of the epoch before it — and after a
+    crash/preemption ``--resume <run_dir>`` does the right thing without the
+    user inspecting which save survived. A truncated or corrupt meta.json
+    (torn emergency write, kill -9 mid-stamp) never wins: it is skipped in
+    favor of older complete saves.
     """
     path = os.path.abspath(path)
     if os.path.exists(os.path.join(path, META_FILE)):
@@ -173,19 +245,24 @@ def resolve_resume_path(path: str) -> str:
                 continue  # corrupt marker: skip, fall back to older complete saves
             epoch = meta.get("epoch")
             if epoch is not None:
-                # Epoch ties are broken EXPLICITLY in favour of scheduled
-                # saves (ckpt_*/last) over emergency crash_* saves — a crash
-                # save at the same recorded epoch holds at best the same
-                # state, and may predate the scheduled save's optimizer I/O.
-                scheduled = 0 if name.startswith("crash") else 1
+                try:
+                    step = int(meta.get("step_in_epoch") or 0)
+                except (TypeError, ValueError):
+                    step = 0  # hand-edited meta: treat as an epoch boundary
+                # Progress ties ((epoch, step) equal) are broken EXPLICITLY
+                # in favour of scheduled saves (ckpt_*/last) over emergency
+                # crash_*/preempt_* saves — an emergency save at the same
+                # recorded progress holds at best the same state, and may
+                # predate the scheduled save's optimizer I/O.
+                scheduled = 0 if name.startswith(("crash", "preempt")) else 1
                 candidates.append(
-                    (int(epoch), scheduled, os.path.join(path, name))
+                    (int(epoch), step, scheduled, os.path.join(path, name))
                 )
     if not candidates:
         raise FileNotFoundError(
             f"{path} contains no complete checkpoint (no */{META_FILE})"
         )
-    return max(candidates)[2]
+    return max(candidates)[3]
 
 
 def restore_checkpoint(path: str, abstract_state) -> Tuple[Any, dict]:
@@ -210,6 +287,13 @@ def restore_checkpoint(path: str, abstract_state) -> Tuple[Any, dict]:
         opt_state=train["opt_state"],
         record_norm_mean=train["record_norm_mean"],
     )
+    # Re-own every restored buffer through the shared jitted copy: orbax
+    # hands back arrays whose host memory the XLA allocator does not own,
+    # and the train steps DONATE their input state — donating a
+    # not-XLA-owned buffer double-frees and corrupts the heap (segfault
+    # within two steps of any resume on the CPU backend; found by
+    # tests/test_fault_injection.py).
+    state = jit_copy_tree(state)
     meta_path = os.path.join(path, META_FILE)
     if not os.path.exists(meta_path):
         # meta.json is stamped only after the payload writes commit; its
@@ -316,8 +400,12 @@ def load_pretrained_variables(path: str, abstract_variables: dict) -> dict:
                 _warn_layout_mismatch(path, json.load(f))
         except ValueError:
             pass
-    return _restore_tree(
+    variables = _restore_tree(
         os.path.join(path, "model"),
         _abstract({"params": abstract_variables["params"],
                    "batch_stats": abstract_variables["batch_stats"]}),
     )
+    # re-own the buffers (see restore_checkpoint): a warm-started pretrain
+    # feeds these into a donating step, and donating orbax-owned host
+    # memory corrupts the heap
+    return jit_copy_tree(variables)

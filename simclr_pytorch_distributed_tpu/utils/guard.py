@@ -1,19 +1,39 @@
-"""Failure detection (SURVEY.md §5: absent upstream — a rank crash just hangs
-NCCL until timeout and all progress is lost since there is no resume).
+"""Failure detection + policy (SURVEY.md §5: absent upstream — a rank crash
+just hangs NCCL until timeout and all progress is lost since there is no
+resume).
 
-Here the cheap, high-value guard is numeric: a non-finite loss observed at the
-metrics fetch aborts the run with an emergency checkpoint of the last known-good
-state instead of silently training on NaNs for hours. Combined with
-``--resume``, the run restarts from the crash checkpoint after the root cause
-(LR spike, bad batch) is addressed.
+Detection: a non-finite loss observed at the metrics fetch raises
+:class:`NonFiniteLossError` instead of silently training on NaNs for hours.
+The check piggybacks on the every-``print_freq`` device sync the meters
+already do, so it adds zero extra host<->device round-trips to the hot loop.
 
-The check piggybacks on the every-``print_freq`` device sync the meters already
-do, so it adds zero extra host<->device round-trips to the hot loop.
+Policy (``--nan_policy``): what the driver DOES about it.
+
+- ``abort`` (default, the original behavior): emergency-checkpoint the last
+  epoch-boundary state as ``crash_epoch_N`` and die; a human addresses the
+  root cause and re-runs with ``--resume``.
+- ``rollback``: self-heal. The driver still writes ``crash_epoch_N``
+  (forensics), then restores the epoch-boundary backup it already keeps for
+  the abort path, SKIPS the poisoned epoch (the step counter advances past it
+  so the LR-schedule position and per-step PRNG stream stay aligned with the
+  epoch number), multiplies the LR by :data:`ROLLBACK_LR_MULT` to damp
+  whatever spiked, and continues. :data:`MAX_ROLLBACKS` consecutive-run
+  rollbacks bound the self-healing — a run whose loss keeps exploding at
+  1/8th of the recipe LR has a real bug and aborts like before.
+
+Preemption (SIGTERM/SIGINT) is the other half of the failure model and lives
+in utils/preempt.py; docs/RESILIENCE.md has the full matrix.
 """
 
 from __future__ import annotations
 
 import math
+
+# Each rollback halves the LR: strong enough that two rollbacks tame a
+# warmup/batch-order spike, gentle enough that one spurious NaN doesn't
+# flatten the schedule.
+ROLLBACK_LR_MULT = 0.5
+MAX_ROLLBACKS = 3
 
 
 class NonFiniteLossError(RuntimeError):
@@ -31,3 +51,36 @@ class NonFiniteLossError(RuntimeError):
 def check_finite_loss(loss: float, step: int, enabled: bool = True) -> None:
     if enabled and not math.isfinite(loss):
         raise NonFiniteLossError(loss, step)
+
+
+class FailurePolicy:
+    """Driver-side decision state for non-finite-loss failures.
+
+    One instance per run. ``should_rollback()`` is consulted from the
+    driver's ``except NonFiniteLossError`` handler AFTER the crash
+    checkpoint is written; when it grants a rollback it also advances the
+    cumulative ``lr_scale`` the driver applies to the schedule.
+    """
+
+    def __init__(
+        self,
+        policy: str = "abort",
+        max_rollbacks: int = MAX_ROLLBACKS,
+        lr_mult: float = ROLLBACK_LR_MULT,
+    ):
+        if policy not in ("abort", "rollback"):
+            raise ValueError(f"unknown nan_policy {policy!r}")
+        self.policy = policy
+        self.max_rollbacks = max_rollbacks
+        self.lr_mult = lr_mult
+        self.rollbacks = 0
+        self.lr_scale = 1.0
+
+    def should_rollback(self) -> bool:
+        """True -> the driver restores the backup and continues; also books
+        the rollback (count + LR damping). False -> abort (re-raise)."""
+        if self.policy != "rollback" or self.rollbacks >= self.max_rollbacks:
+            return False
+        self.rollbacks += 1
+        self.lr_scale *= self.lr_mult
+        return True

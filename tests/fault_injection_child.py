@@ -1,0 +1,69 @@
+"""Child worker for tests/test_fault_injection.py: one REAL OS process
+running the supcon pretrain driver on a tiny synthetic config, so the parent
+can deliver actual signals (SIGTERM, SIGKILL) at randomized steps and then
+resume — the only honest way to test the preemption machinery end-to-end
+(an in-process simulation cannot witness exit codes or kill -9 torn state).
+
+Usage: python fault_injection_child.py <workdir> <epochs> <resume> <trial> \
+           [save_freq]
+
+Prints, on stdout (parent parses these):
+- ``SAVE_FOLDER <path>``  once config is finalized (before training);
+- the driver's ``Train: [e][s/S]`` log lines, one per step (print_freq=1);
+- ``DONE step=<n>`` only when the run completes uninterrupted.
+
+Exit codes: 0 done; preempt.EXIT_PREEMPTED (75) after a clean
+SIGTERM-triggered emergency checkpoint; anything else is a real failure.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+if cache_dir:
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import logging  # noqa: E402
+
+# the parent reads stdout; route the driver's log lines there unbuffered
+logging.basicConfig(stream=sys.stdout, level=logging.INFO, force=True)
+
+from simclr_pytorch_distributed_tpu import config as config_lib  # noqa: E402
+from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib  # noqa: E402
+
+# 256 examples at size 8 -> 224 train -> 7 steps/epoch at batch 32: enough
+# steps that a SIGTERM sent after the first step's log line is always
+# observed MID-epoch (the handler runs during step 2's host code), small
+# enough that a child run is seconds after the first compile is cached.
+_orig_synthetic = cifar_lib.synthetic_dataset
+cifar_lib.synthetic_dataset = (
+    lambda n=2048, num_classes=10, seed=0, size=32: _orig_synthetic(
+        n=256, num_classes=num_classes, seed=seed, size=8
+    )
+)
+
+workdir = sys.argv[1]
+epochs = int(sys.argv[2])
+resume = sys.argv[3]
+trial = sys.argv[4]
+save_freq = int(sys.argv[5]) if len(sys.argv) > 5 else 100
+
+from simclr_pytorch_distributed_tpu.train import supcon as supcon_driver  # noqa: E402
+
+cfg = config_lib.SupConConfig(
+    model="resnet10", dataset="synthetic", batch_size=32, epochs=epochs,
+    learning_rate=0.05, temp=0.5, cosine=True, save_freq=save_freq,
+    print_freq=1, size=8, workdir=workdir, seed=0, method="SimCLR",
+    trial=trial, resume=resume,
+)
+cfg = config_lib.finalize_supcon(cfg)
+print(f"SAVE_FOLDER {cfg.save_folder}", flush=True)
+
+state = supcon_driver.run(cfg)
+print(f"DONE step={int(state.step)}", flush=True)

@@ -188,3 +188,111 @@ def test_save_load_classifier_roundtrip(tmp_path):
     restored = load_classifier(path, params)
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_save_checkpoint_records_step_in_epoch(tmp_path):
+    """Mid-epoch emergency saves stamp (epoch, step_in_epoch) — the full
+    dataset-position coordinate a bit-identical resume needs."""
+    import json
+    import os
+
+    _, _, state = small_state()
+    path = save_checkpoint(
+        str(tmp_path), "preempt_epoch_3_step_7", state, epoch=2, step_in_epoch=7
+    )
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["epoch"] == 2 and meta["step_in_epoch"] == 7
+
+    restored, meta2 = restore_checkpoint(path, state)
+    assert meta2["step_in_epoch"] == 7
+
+
+def test_resolve_resume_corrupt_meta_skipped_for_older_complete(tmp_path):
+    """A truncated/corrupt meta.json (kill -9 mid-stamp, torn disk write)
+    must NEVER win resolution: the older complete save is chosen, and the
+    corrupt one is skipped silently rather than crashing the resolver."""
+    import os
+
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        resolve_resume_path,
+    )
+
+    _, _, state = small_state()
+    p3 = save_checkpoint(str(tmp_path), "ckpt_epoch_3", state, epoch=3)
+    p9 = save_checkpoint(str(tmp_path), "ckpt_epoch_9", state, epoch=9)
+    # three corruption shapes: truncated JSON, garbage bytes, empty file
+    with open(os.path.join(p9, "meta.json"), "w") as f:
+        f.write('{"epoch": 9, "conf')
+    p7 = save_checkpoint(str(tmp_path), "crash_epoch_7", state, epoch=7)
+    with open(os.path.join(p7, "meta.json"), "wb") as f:
+        f.write(b"\x00\xff\x00garbage")
+    p5 = save_checkpoint(str(tmp_path), "preempt_epoch_5_step_2", state,
+                         epoch=5, step_in_epoch=2)
+    with open(os.path.join(p5, "meta.json"), "w") as f:
+        f.write("")
+    assert resolve_resume_path(str(tmp_path)) == p3
+
+
+def test_resolve_resume_mid_epoch_save_outranks_prior_boundary(tmp_path):
+    """Progress ordering: a preemption save at (epoch 4, step 5) holds MORE
+    progress than the scheduled ckpt_epoch_4 (epoch 4, step 0) and less than
+    ckpt_epoch_5 — resolution follows (epoch, step_in_epoch)."""
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        resolve_resume_path,
+    )
+
+    _, _, state = small_state()
+    save_checkpoint(str(tmp_path), "ckpt_epoch_4", state, epoch=4)
+    p_mid = save_checkpoint(str(tmp_path), "preempt_epoch_5_step_5", state,
+                            epoch=4, step_in_epoch=5)
+    assert resolve_resume_path(str(tmp_path)) == p_mid
+
+    p5 = save_checkpoint(str(tmp_path), "ckpt_epoch_5", state, epoch=5)
+    assert resolve_resume_path(str(tmp_path)) == p5
+
+
+def test_resolve_resume_tie_prefers_scheduled_over_preempt(tmp_path):
+    """An epoch-boundary preemption save ties a scheduled save of the same
+    epoch at (epoch, 0): the scheduled save wins, same rule as crash_*."""
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        resolve_resume_path,
+    )
+
+    _, _, state = small_state()
+    save_checkpoint(str(tmp_path), "preempt_epoch_6", state, epoch=6)
+    p_sched = save_checkpoint(str(tmp_path), "ckpt_epoch_6", state, epoch=6)
+    assert resolve_resume_path(str(tmp_path)) == p_sched
+
+
+def test_resume_position_decode_and_garbage_tolerance():
+    """(epoch, step_in_epoch) -> (start_epoch, start_step); a full-epoch or
+    unparseable offset degrades to the next epoch boundary (matching what
+    resolve_resume_path tolerates) instead of crashing the driver."""
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import resume_position
+
+    assert resume_position({"epoch": 3, "step_in_epoch": 7}, 10) == (4, 7)
+    assert resume_position({"epoch": 3}, 10) == (4, 0)
+    assert resume_position({}, 10) == (1, 0)
+    assert resume_position({"epoch": 3, "step_in_epoch": 12}, 10) == (5, 0)
+    assert resume_position({"epoch": 3, "step_in_epoch": "abc"}, 10) == (4, 0)
+    assert resume_position({"epoch": 3, "step_in_epoch": None}, 10) == (4, 0)
+
+
+def test_save_checkpoint_extra_meta_roundtrip(tmp_path):
+    """Driver-side run state (rollback damping, best-acc watermark) rides
+    checkpoint meta and comes back on restore."""
+    import json
+    import os
+
+    _, _, state = small_state()
+    path = save_checkpoint(
+        str(tmp_path), "ckpt_epoch_1", state, epoch=1,
+        extra_meta={"lr_scale": 0.25, "rollbacks": 2, "best_acc": 61.5},
+    )
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["lr_scale"] == 0.25 and meta["rollbacks"] == 2
+    assert meta["best_acc"] == 61.5
+    # reserved keys win over extra_meta collisions
+    assert meta["epoch"] == 1

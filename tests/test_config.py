@@ -185,3 +185,19 @@ def test_ce_syncbn_flag(tmp_path):
         "--workdir", str(tmp_path)], ce=True).syncBN
     with pytest.raises(SystemExit):
         parse_linear(["--syncBN", "--workdir", str(tmp_path)], ce=False)
+
+
+def test_linear_parser_accepts_resume_for_launcher_contract():
+    """Exit code 75's contract is 're-run the same command with --resume':
+    the probe parser must accept the flag (retrain-from-scratch semantics)
+    rather than die with 'unrecognized arguments'."""
+    from simclr_pytorch_distributed_tpu import config as config_lib
+
+    ns = config_lib.linear_parser(ce=False).parse_args(
+        ["--dataset", "synthetic", "--resume", "/some/run_dir"]
+    )
+    assert ns.resume == "/some/run_dir"
+    ns_ce = config_lib.linear_parser(ce=True).parse_args(
+        ["--dataset", "synthetic", "--resume", "/some/run_dir"]
+    )
+    assert ns_ce.resume == "/some/run_dir"

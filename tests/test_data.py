@@ -123,7 +123,7 @@ def test_epoch_loader_prefetch_worker_exception_propagates():
     class Poison(RuntimeError):
         pass
 
-    def poisoned_batches(epoch):
+    def poisoned_batches(epoch, start_step=0):
         yield images[:8], labels[:8]
         raise Poison("bad index / memmap I/O error")
 
@@ -161,3 +161,26 @@ def test_synthetic_texture_dataset_contract():
         for c in range(10)
     ])
     assert means.std(axis=0).max() < 0.1 * tr1["images"].std()
+
+
+def test_epoch_loader_start_step_resumes_permutation():
+    """Mid-epoch resume contract (utils/preempt.py): epoch(e, start_step=k)
+    yields EXACTLY the suffix of the uninterrupted epoch(e) stream — same
+    batches, same order — for both the prefetch-thread and inline paths."""
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 255, (64, 4, 4, 3), dtype=np.uint8)
+    labels = np.arange(64, dtype=np.int32)
+    for prefetch in (0, 2):
+        loader = EpochLoader(images, labels, 16, base_seed=5, prefetch=prefetch)
+        full = list(loader.epoch(3))
+        resumed = list(loader.epoch(3, start_step=2))
+        assert len(full) == 4 and len(resumed) == 2
+        for (fi, fl), (ri, rl) in zip(full[2:], resumed):
+            np.testing.assert_array_equal(fi, ri)
+            np.testing.assert_array_equal(fl, rl)
+
+    loader = EpochLoader(images, labels, 16, base_seed=5)
+    with pytest.raises(ValueError, match="start_step"):
+        next(loader.epoch(3, start_step=4))  # a whole epoch is not an offset
+    with pytest.raises(ValueError, match="start_step"):
+        next(loader.epoch(3, start_step=-1))

@@ -207,6 +207,97 @@ def test_ensure_dataset_available_concurrent_callers(
     assert n_cls == 10 and train["images"].shape[0] == 20
 
 
+class _FlakyHandler(SimpleHTTPRequestHandler):
+    """Fails the first N requests with a 503, then serves normally —
+    the transient-HTTP-failure shape the retry loop is for."""
+
+    failures_left = 0
+
+    def do_GET(self):
+        cls = type(self)
+        if cls.failures_left > 0:
+            cls.failures_left -= 1
+            self.send_error(503, "transient")
+            return
+        super().do_GET()
+
+    def log_message(self, *a):  # keep pytest output clean
+        pass
+
+
+def _serve_flaky(tmp_path, failures):
+    site = tmp_path / "flaky_site"
+    site.mkdir()
+    data, md5 = _tiny_archive("cifar10")
+    (site / CIFAR_ARCHIVES["cifar10"][0]).write_bytes(data)
+    handler = functools.partial(_FlakyHandler, directory=str(site))
+    _FlakyHandler.failures_left = failures
+    server = HTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+
+    def stop():
+        server.shutdown()
+        thread.join()
+
+    return f"http://127.0.0.1:{server.server_port}", md5, stop
+
+
+def test_download_retries_transient_failures(tmp_path):
+    """Two 503s then success: the backoff loop (3 attempts) absorbs the
+    transient failure instead of aborting the multi-host launch that holds
+    the download flock."""
+    url, md5, stop = _serve_flaky(tmp_path, failures=2)
+    try:
+        marker = download_cifar(
+            "cifar10", str(tmp_path / "data"), base_url=url, md5=md5,
+            backoff_base=0.01,
+        )
+        assert os.path.isdir(marker)
+        assert _FlakyHandler.failures_left == 0  # all three attempts fired
+    finally:
+        stop()
+
+
+def test_download_gives_up_after_attempts(tmp_path):
+    """A persistent failure still aborts — after exactly `attempts` tries —
+    and leaves no torn partial file behind."""
+    from urllib.error import HTTPError
+
+    url, md5, stop = _serve_flaky(tmp_path, failures=99)
+    try:
+        with pytest.raises(HTTPError):
+            download_cifar(
+                "cifar10", str(tmp_path / "data"), base_url=url, md5=md5,
+                backoff_base=0.01,
+            )
+    finally:
+        stop()
+    assert _FlakyHandler.failures_left == 99 - 3  # 3 attempts, no more
+    fname = CIFAR_ARCHIVES["cifar10"][0]
+    leftovers = [p for p in (tmp_path / "data").iterdir() if fname in p.name]
+    assert not leftovers  # neither the archive nor a .partial survives
+
+
+def test_download_md5_mismatch_retries_then_fails(tmp_path, caplog):
+    """An md5 mismatch is treated as a truncated transfer: retried (fresh
+    temp each attempt), and only after the retry budget does it raise."""
+    import logging
+
+    url, _, stop = _serve_flaky(tmp_path, failures=0)
+    try:
+        with caplog.at_level(logging.WARNING):
+            with pytest.raises(ValueError, match="md5 mismatch"):
+                download_cifar(
+                    "cifar10", str(tmp_path / "data"), base_url=url,
+                    md5="0" * 32, backoff_base=0.01,
+                )
+    finally:
+        stop()
+    retries = [r for r in caplog.records if "retrying" in r.message]
+    assert len(retries) == 2  # attempts 1 and 2 warned; attempt 3 raised
+
+
 def test_download_cifar100_archive_shape(tmp_path):
     """The cifar100 archive constants (name, marker dir, pickle layout) drive
     the same fetch->extract->load path northstar --dataset cifar100 uses."""

@@ -1,4 +1,5 @@
-"""NaN-loss failure detection: abort + emergency checkpoint via the driver."""
+"""NaN-loss failure detection + policy: abort vs rollback, and the
+preemption flag's flush-boundary observation in the epoch loop."""
 
 import math
 
@@ -10,7 +11,11 @@ import pytest
 from simclr_pytorch_distributed_tpu import config as config_lib
 from simclr_pytorch_distributed_tpu.parallel.mesh import create_mesh
 from simclr_pytorch_distributed_tpu.train.supcon import train_one_epoch
+from simclr_pytorch_distributed_tpu.utils import preempt
 from simclr_pytorch_distributed_tpu.utils.guard import (
+    MAX_ROLLBACKS,
+    ROLLBACK_LR_MULT,
+    FailurePolicy,
     NonFiniteLossError,
     check_finite_loss,
 )
@@ -28,10 +33,10 @@ class _FakeLoader:
     def __init__(self, n_steps, batch):
         self.n_steps, self.batch = n_steps, batch
 
-    def epoch(self, _):
+    def epoch(self, _, start_step=0):
         images = np.zeros((self.batch, 4, 4, 3), np.uint8)
         labels = np.zeros((self.batch,), np.int32)
-        for _ in range(self.n_steps):
+        for _ in range(start_step, self.n_steps):
             yield images, labels
 
 
@@ -55,8 +60,135 @@ def test_epoch_loop_raises_on_nan(monkeypatch):
 
     # guard off: the same epoch completes and reports the NaN average
     cfg_off = config_lib.SupConConfig(print_freq=1, batch_size=8, nan_guard=False)
-    _, loss_avg, _ = train_one_epoch(
+    _, loss_avg, _, preempted_at = train_one_epoch(
         1, _FakeLoader(3, 8), fake_update, state=None, mesh=mesh,
         base_key=jax.random.key(0), cfg=cfg_off, tb=None, steps_per_epoch=3,
     )
     assert math.isnan(loss_avg)
+    assert preempted_at is None
+
+
+def _finite_metrics():
+    return {
+        "loss": jnp.float32(1.0), "norm_mean": jnp.float32(0),
+        "norm_var": jnp.float32(0), "record_norm_mean": jnp.float32(0),
+        "loss_sec": jnp.float32(0), "loss_l2reg": jnp.float32(0),
+    }
+
+
+def test_epoch_loop_observes_preemption_at_flush_boundary():
+    """The flag set by the (simulated) signal is observed at the NEXT
+    print_freq flush; the loop returns the steps-completed count so the
+    driver can stamp step_in_epoch into the emergency save."""
+    cfg = config_lib.SupConConfig(print_freq=2, batch_size=8)
+    mesh = create_mesh(devices=jax.devices()[:1])
+    metrics = _finite_metrics()
+
+    calls = []
+
+    def fake_update(state, images, labels, key):
+        calls.append(1)
+        if len(calls) == 1:
+            preempt.request()  # signal lands during step 1's window
+        return state, metrics
+
+    try:
+        state, loss_avg, _, preempted_at = train_one_epoch(
+            1, _FakeLoader(8, 8), fake_update, state=None, mesh=mesh,
+            base_key=jax.random.key(0), cfg=cfg, tb=None, steps_per_epoch=8,
+        )
+    finally:
+        preempt.uninstall()
+    assert preempted_at == 2  # observed at the first flush (print_freq=2)
+    assert len(calls) == 2  # no further steps dispatched
+    assert loss_avg == 1.0
+
+
+def test_epoch_loop_last_step_preemption_falls_through():
+    """A signal observed only at the final flush is an ordinary epoch end:
+    the epoch-boundary path in run() handles it (no mid-epoch marker)."""
+    cfg = config_lib.SupConConfig(print_freq=10, batch_size=8)
+    mesh = create_mesh(devices=jax.devices()[:1])
+    metrics = _finite_metrics()
+
+    def fake_update(state, images, labels, key):
+        preempt.request()
+        return state, metrics
+
+    try:
+        _, _, _, preempted_at = train_one_epoch(
+            1, _FakeLoader(3, 8), fake_update, state=None, mesh=mesh,
+            base_key=jax.random.key(0), cfg=cfg, tb=None, steps_per_epoch=3,
+        )
+        assert preempted_at is None
+        assert preempt.requested()  # still pending for run()'s boundary check
+    finally:
+        preempt.uninstall()
+
+
+def test_failure_policy_abort_never_rolls_back():
+    p = FailurePolicy("abort")
+    assert not p.should_rollback()
+    assert p.lr_scale == 1.0 and p.rollbacks == 0
+
+
+def test_failure_policy_rollback_damps_lr_and_caps():
+    p = FailurePolicy("rollback")
+    grants = [p.should_rollback() for _ in range(MAX_ROLLBACKS + 2)]
+    assert grants == [True] * MAX_ROLLBACKS + [False, False]
+    assert p.rollbacks == MAX_ROLLBACKS
+    np.testing.assert_allclose(p.lr_scale, ROLLBACK_LR_MULT ** MAX_ROLLBACKS)
+
+
+def test_failure_policy_rejects_unknown():
+    with pytest.raises(ValueError, match="nan_policy"):
+        FailurePolicy("retry")
+
+
+def test_preempt_install_uninstall_roundtrip():
+    """install() swaps handlers in, uninstall() restores the originals and
+    clears the flag — a driver run inside pytest leaves SIGINT alone."""
+    import signal
+
+    before = signal.getsignal(signal.SIGTERM)
+    preempt.install()
+    try:
+        assert not preempt.requested()
+        preempt.request()
+        assert preempt.requested()
+        assert preempt.signal_name() == "SIGTERM"
+    finally:
+        preempt.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is before
+    assert not preempt.requested()
+
+
+def test_realign_schedule_count_moves_applied_lr_position():
+    """The applied LR reads ScaleByScheduleState.count, not TrainState.step:
+    the rollback's epoch skip must move BOTH (sgd and lars chains), and a
+    constant-LR chain is a no-op."""
+    import optax
+
+    from simclr_pytorch_distributed_tpu.train.state import (
+        make_optimizer,
+        realign_schedule_count,
+    )
+
+    params = {"w": jnp.ones((3, 3))}
+    for opt in ("sgd", "lars"):
+        tx = make_optimizer(lambda s: 0.1, momentum=0.9, weight_decay=1e-4,
+                            optimizer=opt)
+        st = realign_schedule_count(tx.init(params), 42)
+        counts = [s.count for s in jax.tree.leaves(
+            st, is_leaf=lambda s: isinstance(s, optax.ScaleByScheduleState)
+        ) if isinstance(s, optax.ScaleByScheduleState)]
+        assert len(counts) == 1 and int(counts[0]) == 42, opt
+        # everything else untouched
+        trace = [s for s in jax.tree.leaves(
+            st, is_leaf=lambda s: isinstance(s, optax.TraceState)
+        ) if isinstance(s, optax.TraceState)]
+        assert trace, opt
+
+    tx_const = make_optimizer(0.1, momentum=0.9, weight_decay=1e-4)
+    st = tx_const.init(params)
+    assert realign_schedule_count(st, 7) == st  # no schedule state: no-op

@@ -183,6 +183,55 @@ def test_honest_timing_harness_smoke():
     assert np.isfinite(dt) and dt >= 0.0
 
 
+def test_honest_timing_rejects_degenerate_iters():
+    """iters < 2 cannot subtract the dispatch floor: a bad CLI --iters flag
+    must fail with a clear message BEFORE the warmup compiles, not with a
+    ZeroDivisionError after them (ADVICE.md round 5)."""
+    import pytest
+
+    ht = _load("_honest_timing")
+
+    def core(i, lead):
+        return jnp.sum(lead)
+
+    for bad in (1, 0, -3):
+        with pytest.raises(ValueError, match="iters must be >= 2"):
+            ht.time_per_iter(core, (jnp.ones((4,), jnp.float32),), iters=bad)
+
+
+def test_crop_ab_patch_brackets_compilation():
+    """The pipeline-level A/B patches augment.crop_and_resize at the
+    make_core level (_patched_crop), so EVERY trace of the timed program —
+    including re-traces from jit cache misses — sees the selected backend
+    (ADVICE.md round 5: an inside-the-core patch only covered the first
+    trace)."""
+    import jax
+
+    crop_ab = _load("crop_ab")
+    from simclr_pytorch_distributed_tpu.ops import augment
+
+    orig = augment.crop_and_resize
+    seen = []
+
+    def fake_crop(img, top, left, h, w, out_size):
+        seen.append(1)
+        return orig(img, top, left, h, w, out_size)
+
+    core = crop_ab._pipeline_core(fake_crop)
+    imgs = jnp.ones((2, 32, 32, 3), jnp.float32) * 128.0
+    with crop_ab._patched_crop(fake_crop):
+        assert augment.crop_and_resize is fake_crop
+        out = core(0, imgs, jax.random.key(0))
+        assert np.isfinite(float(out))
+    assert augment.crop_and_resize is orig  # restored after the window
+    assert seen  # the selected backend was actually traced
+
+    # outside the patch window the core refuses to run (the trace would
+    # silently time the production backend)
+    with pytest.raises(AssertionError, match="_patched_crop"):
+        core(0, imgs, jax.random.key(0))
+
+
 # -------------------------------------------------------------- xplane_bw
 
 
