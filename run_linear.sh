@@ -1,7 +1,30 @@
 #!/usr/bin/env bash
 # TPU equivalent of the reference run_linear.sh (single-GPU linear probe).
 # Usage: ./run_linear.sh --ckpt work_space/cifar10_models/<run>/last
-python main_linear.py \
-  --learning_rate 5 \
-  --batch_size 256 \
-  "$@"
+#
+# Exit-75 contract (docs/RESILIENCE.md): the probe keeps no full-state
+# checkpoints (epochs are seconds) — on preemption it persists the best
+# classifier so far and exits 75; this launcher relaunches up to
+# PREEMPT_RETRIES (default 3) times. --resume for the probe means exactly
+# "retrain from scratch" (config.linear_parser documents the contract).
+
+set -uo pipefail
+
+max_retries=${PREEMPT_RETRIES:-3}
+attempt=0
+resume_args=()
+while true; do
+  python main_linear.py \
+    --learning_rate 5 \
+    --batch_size 256 \
+    "$@" \
+    ${resume_args[@]+"${resume_args[@]}"}
+  rc=$?
+  if [ "$rc" -ne 75 ] || [ "$attempt" -ge "$max_retries" ]; then
+    exit "$rc"
+  fi
+  attempt=$((attempt + 1))
+  resume_args=(--resume preempted-retry)
+  echo "run_linear.sh: preempted (exit 75); retry $attempt/$max_retries" \
+       "(probe retrains from scratch)" >&2
+done

@@ -2,13 +2,59 @@
 # TPU equivalent of the reference run_supcon.sh (2-GPU DDP launch):
 # no torch.distributed.launch — one process drives every local chip via the mesh.
 # --ngpu 2 keeps the reference's DDP gradient-scale for recipe parity.
-python main_supcon.py \
-  --syncBN \
-  --epochs 100 \
-  --batch_size 256 \
-  --learning_rate 0.5 \
-  --temp 0.5 \
-  --cosine \
-  --method SimCLR \
-  --ngpu 2 \
-  "$@"
+#
+# Exit-75 contract (docs/RESILIENCE.md): 75 means "preempted, state saved
+# cleanly, re-run with --resume <run_dir>". This launcher closes that loop —
+# up to PREEMPT_RETRIES (default 3) relaunches, resuming from the newest
+# pretrain run dir under the workdir (resolve_resume_path picks the complete
+# checkpoint with the most progress inside it). Any other exit code passes
+# through untouched.
+
+set -uo pipefail
+
+max_retries=${PREEMPT_RETRIES:-3}
+
+# honor a --workdir override in the passthrough args (main_supcon.py default);
+# both argparse spellings: '--workdir DIR' and '--workdir=DIR'
+workdir=./work_space
+prev=
+for a in "$@"; do
+  if [ "$prev" = "--workdir" ]; then workdir=$a; fi
+  case "$a" in --workdir=*) workdir=${a#--workdir=} ;; esac
+  prev=$a
+done
+
+# NOTE: resume_args comes AFTER "$@" — argparse is last-wins, so on a retry
+# the freshly resolved run dir beats any stale --resume the user passed.
+attempt=0
+resume_args=()
+while true; do
+  python main_supcon.py \
+    --syncBN \
+    --epochs 100 \
+    --batch_size 256 \
+    --learning_rate 0.5 \
+    --temp 0.5 \
+    --cosine \
+    --method SimCLR \
+    --ngpu 2 \
+    "$@" \
+    ${resume_args[@]+"${resume_args[@]}"}
+  rc=$?
+  if [ "$rc" -ne 75 ] || [ "$attempt" -ge "$max_retries" ]; then
+    exit "$rc"
+  fi
+  attempt=$((attempt + 1))
+  # newest pretrain run dir; probe/CE folders are classifier_*/ce_*-prefixed.
+  # Filter on the run-dir BASENAME ($(NF-1): paths end in /), not the whole
+  # path — a workdir like /data/ce_experiments must not hide every candidate.
+  run_dir=$(ls -1dt "$workdir"/*_models/*/ 2>/dev/null \
+            | awk -F/ '$(NF-1) !~ /^(classifier_|ce_)/' | head -1 || true)
+  if [ -n "$run_dir" ]; then
+    resume_args=(--resume "$run_dir")
+  else
+    resume_args=()
+  fi
+  echo "run_supcon.sh: preempted (exit 75); retry $attempt/$max_retries," \
+       "resuming from '${run_dir:-scratch}'" >&2
+done

@@ -23,7 +23,14 @@ DMA engines overlap H2D with compute regardless; the tunneled bench chip
 serializes more aggressively, which is exactly why it must be measured
 rather than assumed.
 
-Usage: python scripts/h2d_overlap_ab.py [--json OUT]
+Usage: python scripts/h2d_overlap_ab.py [--runs N] [--json OUT]
+
+``--runs N`` repeats the whole three-variant measurement N times in-process
+and emits the aggregated ``{"runs": [...]}`` schema directly — the schema
+the committed ``docs/evidence/h2d_overlap_ab_r5.json`` artifact uses — so
+multi-run evidence is reproducible mechanically instead of hand-assembled
+(ADVICE.md round 5). ``--runs 1`` (default) keeps the single-invocation
+``{"variants": {...}}`` schema.
 """
 
 import argparse
@@ -47,11 +54,58 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (  # noqa: E402
 BATCH, SIZE = 256, 32
 N_STEPS, WINDOWS, N_BUFFERS = 20, 5, 8
 
+_NOTE = (
+    "resident = zero per-step transfer floor; put_then_step = "
+    "current driver loop; step_then_put = double-buffered "
+    "prefetch-to-device"
+)
+
+
+def build_output(batch, device, per_run_records, per_run_glitched):
+    """Assemble the artifact JSON from N in-process runs.
+
+    One run keeps the original ``{"variants": {...}}`` schema; several runs
+    emit the ``{"runs": [...]}`` schema of the committed
+    ``docs/evidence/h2d_overlap_ab_r5.json`` (glitch counts summed across
+    runs and variants), so the multi-run artifact regenerates mechanically.
+    """
+    if len(per_run_records) == 1:
+        return {
+            "metric": "h2d_overlap_ab_step_ms",
+            "batch": batch,
+            "variants": per_run_records[0],
+            "windows_discarded_as_clock_glitch": per_run_glitched[0],
+            "device": device,
+            "note": _NOTE,
+        }
+    total_glitched = sum(
+        sum(g.values()) for g in per_run_glitched
+    )
+    return {
+        "metric": "h2d_overlap_ab_step_ms",
+        "batch": batch,
+        "runs": per_run_records,
+        "windows_discarded_as_clock_glitch": total_glitched,
+        "device": device,
+        "note": (
+            f"{len(per_run_records)} in-process runs of the three-variant "
+            f"measurement back to back (median credible window each; "
+            f"--runs {len(per_run_records)}). " + _NOTE
+        ),
+    }
+
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", default=None)
+    ap.add_argument(
+        "--runs", type=int, default=1,
+        help="repeat the whole measurement N times in-process and emit the "
+             "aggregated {runs: [...]} schema (the committed r5 artifact's)",
+    )
     args = ap.parse_args()
+    if args.runs < 1:
+        ap.error("--runs must be >= 1")
 
     mesh = create_mesh()
     update, sh_images, sh_labels, state, _, _ = bench._setup_pretrain(
@@ -122,30 +176,25 @@ def main():
                 dev = shard_host_batch(host_batches[(i + 1) % N_BUFFERS], mesh)
         return metrics
 
-    records, glitched = {}, {}
-    for name, body in (
-        ("resident", resident),
-        ("put_then_step", put_then_step),
-        ("step_then_put", step_then_put),
-    ):
-        per_step, n_glitched = run_windows(body)
-        records[name] = round(per_step * 1e3, 2)
-        glitched[name] = n_glitched
-        print(json.dumps({
-            "variant": name, "step_ms": records[name],
-            "windows_discarded_as_clock_glitch": n_glitched,
-        }), flush=True)
+    per_run_records, per_run_glitched = [], []
+    for run in range(args.runs):
+        records, glitched = {}, {}
+        for name, body in (
+            ("resident", resident),
+            ("put_then_step", put_then_step),
+            ("step_then_put", step_then_put),
+        ):
+            per_step, n_glitched = run_windows(body)
+            records[name] = round(per_step * 1e3, 2)
+            glitched[name] = n_glitched
+            print(json.dumps({
+                "run": run, "variant": name, "step_ms": records[name],
+                "windows_discarded_as_clock_glitch": n_glitched,
+            }), flush=True)
+        per_run_records.append(records)
+        per_run_glitched.append(glitched)
 
-    out = {
-        "metric": "h2d_overlap_ab_step_ms",
-        "batch": BATCH,
-        "variants": records,
-        "windows_discarded_as_clock_glitch": glitched,
-        "device": kind,
-        "note": "resident = zero per-step transfer floor; put_then_step = "
-                "current driver loop; step_then_put = double-buffered "
-                "prefetch-to-device",
-    }
+    out = build_output(BATCH, kind, per_run_records, per_run_glitched)
     print(json.dumps(out))
     if args.json:
         with open(args.json, "w") as f:

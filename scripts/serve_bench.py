@@ -1,0 +1,338 @@
+#!/usr/bin/env python
+"""Latency/throughput benchmark for the serve/ subsystem.
+
+Two client models against one engine+batcher stack:
+
+- **closed loop** — C client threads, each submitting back-to-back (a new
+  request the moment the last completes). Measures the stack's saturated
+  throughput and the latency it costs.
+- **open loop** — Poisson arrivals at a fixed rate, submitted on schedule
+  regardless of completions (the honest service-latency model: a closed loop
+  self-throttles and hides queueing, an open loop exposes it).
+
+Latencies are recorded per request and reported as p50/p95/p99 **per
+bucket** (the engine pads request sizes up to jit buckets, so e.g. size-5
+and size-7 requests share the bucket-8 program and the same latency
+population). Results go to a JSON artifact (``--json``, default
+``docs/evidence/serve_bench_smoke.json`` in smoke mode).
+
+``--smoke`` is the CI end-to-end proof (tests/test_scripts.py): tiny
+random-init model on CPU, a short closed + open loop through the REAL
+DynamicBatcher, a duplicate-image pass through the REAL cache, and one
+round trip through the REAL HTTP endpoint (/healthz, /embed, /stats on an
+ephemeral port) — engine → batcher → cache → HTTP, nothing mocked.
+
+Usage:
+    python scripts/serve_bench.py --smoke
+    python scripts/serve_bench.py --ckpt <run_dir>/last --duration 10 \
+        --rate 200 --clients 8 --json docs/evidence/serve_bench.json
+"""
+
+import argparse
+import base64
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simclr_pytorch_distributed_tpu.serve.batcher import (  # noqa: E402
+    DynamicBatcher,
+    QueueFull,
+)
+from simclr_pytorch_distributed_tpu.serve.cache import EmbeddingCache  # noqa: E402
+from simclr_pytorch_distributed_tpu.serve.engine import EmbeddingEngine  # noqa: E402
+from simclr_pytorch_distributed_tpu.serve.server import (  # noqa: E402
+    combined_stats_fn,
+    create_server,
+    start_in_thread,
+)
+
+
+def percentiles(latencies_ms):
+    if not latencies_ms:
+        return None
+    arr = np.asarray(latencies_ms)
+    return {
+        "n": int(arr.size),
+        "p50_ms": round(float(np.percentile(arr, 50)), 3),
+        "p95_ms": round(float(np.percentile(arr, 95)), 3),
+        "p99_ms": round(float(np.percentile(arr, 99)), 3),
+        "mean_ms": round(float(arr.mean()), 3),
+        "max_ms": round(float(arr.max()), 3),
+    }
+
+
+def per_bucket_report(records, engine):
+    """records: [(request_size, latency_ms)] -> {bucket: percentiles}."""
+    by_bucket = {}
+    for size, lat in records:
+        by_bucket.setdefault(engine.bucket_for(size), []).append(lat)
+    return {
+        str(bucket): percentiles(lats)
+        for bucket, lats in sorted(by_bucket.items())
+    }
+
+
+def make_images(rng, n, size):
+    return rng.integers(0, 256, size=(n, size, size, 3), dtype=np.uint8)
+
+
+def closed_loop(batcher, rng, *, clients, requests_per_client, sizes, size):
+    """Each client thread submits back-to-back; returns (records, elapsed_s,
+    total_images)."""
+    records = []
+    lock = threading.Lock()
+
+    def client(seed):
+        crng = np.random.default_rng(seed)
+        for _ in range(requests_per_client):
+            n = int(crng.choice(sizes))
+            images = make_images(crng, n, size)
+            t0 = time.perf_counter()
+            batcher.submit(images).result(timeout=120)
+            dt = (time.perf_counter() - t0) * 1e3
+            with lock:
+                records.append((n, dt))
+
+    threads = [
+        threading.Thread(target=client, args=(1000 + i,)) for i in range(clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    return records, elapsed, sum(n for n, _ in records)
+
+
+def open_loop(batcher, rng, *, rate_rps, n_requests, sizes, size):
+    """Poisson arrivals at ``rate_rps``; submission never waits on
+    completions (futures resolve via callback)."""
+    records = []
+    lock = threading.Lock()
+    pending = []
+    shed = 0
+    gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
+    t_start = time.perf_counter()
+    t_next = t_start
+    for i in range(n_requests):
+        t_next += gaps[i]
+        delay = t_next - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        n = int(rng.choice(sizes))
+        images = make_images(rng, n, size)
+        t0 = time.perf_counter()
+
+        def on_done(fut, n=n, t0=t0):
+            dt = (time.perf_counter() - t0) * 1e3
+            if fut.exception() is None:
+                with lock:
+                    records.append((n, dt))
+
+        try:
+            fut = batcher.submit(images)
+        except QueueFull:
+            # open loop beyond capacity: backpressure sheds load instead of
+            # growing the queue — count it, don't crash the arrival schedule
+            shed += 1
+            continue
+        fut.add_done_callback(on_done)
+        pending.append(fut)
+    for fut in pending:
+        fut.result(timeout=120)
+    elapsed = time.perf_counter() - t_start
+    return records, elapsed, sum(n for n, _ in records), shed
+
+
+def http_round_trip(engine, batcher, size):
+    """One real round trip through the stdlib HTTP endpoint on an ephemeral
+    port: /healthz, /embed (both JSON encodings), /stats."""
+    server = create_server(batcher, combined_stats_fn(engine, batcher), port=0)
+    start_in_thread(server)
+    host, port = server.server_address[:2]
+    base = f"http://{host}:{port}"
+    out = {}
+    try:
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            out["healthz"] = json.loads(r.read())["status"]
+        images = make_images(np.random.default_rng(7), 2, size)
+        body = json.dumps({
+            "images_b64": base64.b64encode(images.tobytes()).decode(),
+            "shape": list(images.shape),
+        }).encode()
+        req = urllib.request.Request(
+            f"{base}/embed", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            reply = json.loads(r.read())
+        out["embed_dim"] = reply["dim"]
+        out["embed_n"] = reply["n"]
+        # nested-list encoding of the same images must give the same answer
+        body2 = json.dumps({"images": images.tolist()}).encode()
+        req2 = urllib.request.Request(
+            f"{base}/embed", data=body2,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req2, timeout=60) as r:
+            reply2 = json.loads(r.read())
+        out["encodings_agree"] = bool(
+            np.allclose(reply["embeddings"], reply2["embeddings"])
+        )
+        with urllib.request.urlopen(f"{base}/stats", timeout=10) as r:
+            stats = json.loads(r.read())
+        out["stats_keys"] = sorted(stats)
+    finally:
+        server.shutdown()
+        server.server_close()
+    return out
+
+
+def cache_pass(batcher, engine, rng, size):
+    """Submit the SAME images twice; the second pass must be answered from
+    the cache (hits recorded, no new engine dispatches)."""
+    images = make_images(rng, 4, size)
+    batcher.submit(images).result(timeout=120)
+    before = engine.stats()
+    t0 = time.perf_counter()
+    batcher.submit(images).result(timeout=120)
+    warm_ms = (time.perf_counter() - t0) * 1e3
+    after = engine.stats()
+    return {
+        "warm_latency_ms": round(warm_ms, 3),
+        "hit_rows": after["cache_hit_rows"] - before["cache_hit_rows"],
+        "extra_dispatches": (
+            sum(after["bucket_dispatches"].values())
+            - sum(before["bucket_dispatches"].values())
+        ),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--ckpt", default="",
+                   help="checkpoint/run dir or .pth; empty = random init")
+    p.add_argument("--model", default="resnet10")
+    p.add_argument("--img_size", type=int, default=32)
+    p.add_argument("--buckets", default="1,8,32,128")
+    p.add_argument("--max_batch", type=int, default=128)
+    p.add_argument("--max_wait_ms", type=float, default=5.0)
+    p.add_argument("--max_queue", type=int, default=512)
+    p.add_argument("--cache_capacity", type=int, default=4096)
+    p.add_argument("--normalize", action="store_true")
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--requests_per_client", type=int, default=25)
+    p.add_argument("--rate", type=float, default=100.0,
+                   help="open-loop Poisson arrival rate (requests/s)")
+    p.add_argument("--open_requests", type=int, default=200)
+    p.add_argument("--sizes", default="1,3,8,20",
+                   help="request sizes drawn uniformly per request")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json", dest="json_out", default=None)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny CPU end-to-end: engine→batcher→cache→HTTP")
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        # small enough that two bucket compiles + the loops fit a CI budget
+        args.model = args.model if args.ckpt else "resnet10"
+        args.img_size = min(args.img_size, 8)
+        args.buckets = "2,8"
+        args.max_batch = 8
+        args.sizes = "1,2,5"
+        args.clients = 3
+        args.requests_per_client = 4
+        args.rate = 200.0
+        args.open_requests = 12
+        if args.json_out is None:
+            args.json_out = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "docs", "evidence", "serve_bench_smoke.json",
+            )
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    sizes = tuple(int(s) for s in args.sizes.split(","))
+    cache = EmbeddingCache(args.cache_capacity) if args.cache_capacity else None
+    # the bench generates --img_size images, so pin the engine to match even
+    # when a checkpoint's recorded training size differs
+    kwargs = dict(buckets=buckets, normalize=args.normalize, cache=cache,
+                  img_size=args.img_size)
+    if args.ckpt:
+        engine = EmbeddingEngine.from_checkpoint(args.ckpt, **kwargs)
+    else:
+        engine = EmbeddingEngine.random_init(
+            model_name=args.model, size=args.img_size, seed=args.seed, **kwargs
+        )
+    batcher = DynamicBatcher(
+        engine.embed, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+        validate=engine.validate_images,
+    )
+    rng = np.random.default_rng(args.seed)
+
+    # warm every bucket OUTSIDE the timed loops: compiles are a one-time cost
+    # the steady-state latency distribution must not absorb
+    for b in buckets:
+        engine.embed(make_images(rng, b, args.img_size))
+
+    closed_records, closed_s, closed_images = closed_loop(
+        batcher, rng, clients=args.clients,
+        requests_per_client=args.requests_per_client,
+        sizes=sizes, size=args.img_size,
+    )
+    open_records, open_s, open_images, open_shed = open_loop(
+        batcher, rng, rate_rps=args.rate, n_requests=args.open_requests,
+        sizes=sizes, size=args.img_size,
+    )
+    cache_result = cache_pass(batcher, engine, rng, args.img_size) if cache else None
+    http_result = http_round_trip(engine, batcher, args.img_size)
+    batcher.close()
+
+    out = {
+        "metric": "serve_bench",
+        "mode": "smoke" if args.smoke else "full",
+        "model": engine.model.model_name,
+        "img_size": args.img_size,
+        "buckets": list(buckets),
+        "max_batch": args.max_batch,
+        "max_wait_ms": args.max_wait_ms,
+        "request_sizes": list(sizes),
+        "closed_loop": {
+            "clients": args.clients,
+            "requests": len(closed_records),
+            "throughput_rps": round(len(closed_records) / closed_s, 2),
+            "throughput_imgs_per_s": round(closed_images / closed_s, 2),
+            "latency_by_bucket": per_bucket_report(closed_records, engine),
+        },
+        "open_loop": {
+            "target_rate_rps": args.rate,
+            "requests": len(open_records),
+            "shed_by_backpressure": open_shed,
+            "achieved_rate_rps": round(len(open_records) / open_s, 2),
+            "throughput_imgs_per_s": round(open_images / open_s, 2),
+            "latency_by_bucket": per_bucket_report(open_records, engine),
+        },
+        "cache": cache_result,
+        "http": http_result,
+        "engine_stats": engine.stats(),
+        "batcher_stats": batcher.stats(),
+        "device": str(engine.mesh.devices.flat[0].device_kind),
+    }
+    print(json.dumps(out, indent=1))
+    if args.json_out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.json_out)), exist_ok=True)
+        with open(args.json_out, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
+if __name__ == "__main__":
+    main()

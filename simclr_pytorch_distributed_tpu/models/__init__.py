@@ -10,5 +10,6 @@ from simclr_pytorch_distributed_tpu.models.heads import (  # noqa: F401
     LinearClassifier,
     SupCEResNet,
     SupConResNet,
+    infer_architecture_from_variables,
 )
 from simclr_pytorch_distributed_tpu.models.norm import CrossReplicaBatchNorm  # noqa: F401

@@ -16,13 +16,14 @@ and bias) so the published recipe's init statistics carry over.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import re
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from simclr_pytorch_distributed_tpu.models.resnet import MODEL_DICT
+from simclr_pytorch_distributed_tpu.models.resnet import MODEL_DICT, Bottleneck
 
 
 class TorchDense(nn.Module):
@@ -100,6 +101,54 @@ class SupConResNet(nn.Module):
         """Encoder features only — the probe's frozen feature extractor
         (reference main_linear.py:170-172)."""
         return self.encoder(x, train=train)
+
+
+def infer_architecture_from_variables(variables: dict) -> Tuple[str, str, int]:
+    """``(model_name, head, feat_dim)`` from a ``SupConResNet`` params tree.
+
+    The checkpoint layer can restore a ``model`` payload without an abstract
+    tree (``utils/checkpoint.load_model_payload``), but consumers still need
+    to know WHICH architecture the tree encodes to rebuild the module — this
+    reads it off the tree itself (stage block counts + Bottleneck's third
+    conv + the proj_head leaf shapes), the orbax-side analogue of
+    ``utils/torch_convert.infer_architecture`` for reference state_dicts.
+    Accepts ``{'params': ..., ...}`` or a bare params tree.
+    """
+    params = variables.get("params", variables)
+    try:
+        enc = params["encoder"]
+        head_tree = params["proj_head"]
+    except (KeyError, TypeError):
+        raise ValueError(
+            "variables tree has no encoder/proj_head — not a SupConResNet "
+            f"checkpoint (top-level keys: {sorted(params)})"
+        )
+    stages = [0, 0, 0, 0]
+    for name in enc:
+        if m := re.match(r"layer(\d)_block(\d+)$", name):
+            layer, block = int(m.group(1)), int(m.group(2))
+            stages[layer - 1] = max(stages[layer - 1], block + 1)
+    bottleneck = "Conv_2" in enc.get("layer1_block0", {})
+    name = next(
+        (
+            n for n, (ctor, _) in MODEL_DICT.items()
+            if tuple(ctor().stage_sizes) == tuple(stages)
+            and (ctor().block_cls is Bottleneck) == bottleneck
+        ),
+        None,
+    )
+    if name is None:
+        raise ValueError(
+            f"unrecognized encoder geometry: stages={tuple(stages)}, "
+            f"bottleneck={bottleneck}"
+        )
+    if "fc1" in head_tree:
+        head, feat_dim = "mlp", int(head_tree["fc2"]["kernel"].shape[-1])
+    elif "fc" in head_tree:
+        head, feat_dim = "linear", int(head_tree["fc"]["kernel"].shape[-1])
+    else:
+        raise ValueError(f"unrecognized proj_head tree: {sorted(head_tree)}")
+    return name, head, feat_dim
 
 
 class SupCEResNet(nn.Module):

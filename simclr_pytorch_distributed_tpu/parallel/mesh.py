@@ -129,6 +129,20 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def batch_sharding_if_divisible(mesh: Mesh, batch: int, ndim: int = 1) -> NamedSharding:
+    """Batch sharding when the size divides the 'data' axis, else replicated.
+
+    GSPMD requires the sharded dim to divide the axis; serving-style
+    callers with a FIXED small batch (the engine's jit buckets,
+    serve/engine.py) want "shard when it fits, fall back to one-device
+    replication when it doesn't" rather than an error — a bucket of 1 on an
+    8-chip mesh is a latency path, not a mistake.
+    """
+    if batch % mesh.shape.get(DATA_AXIS, 1) == 0:
+        return batch_sharding(mesh, ndim)
+    return replicated_sharding(mesh)
+
+
 def tp_leaf_spec(shape, model_size: int, min_last: int = 64) -> P:
     """Channel-wise tensor-parallel spec for one state leaf.
 

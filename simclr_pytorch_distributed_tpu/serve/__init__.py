@@ -1,0 +1,25 @@
+"""serve/ — batched embedding-inference subsystem.
+
+Turns any checkpoint this repo produces (or imports from the reference
+``.pth`` format) into a high-throughput embedding service:
+
+- :mod:`engine` — ``EmbeddingEngine``: checkpoint -> eval-mode encoder behind
+  a shape-bucketed jit cache (arbitrary request sizes never recompile);
+- :mod:`batcher` — ``DynamicBatcher``: async request queue coalescing
+  concurrent submits into micro-batches under ``max_batch``/``max_wait_ms``,
+  with bounded-queue backpressure (``QueueFull``) and per-request timeouts;
+- :mod:`cache` — ``EmbeddingCache``: content-keyed LRU over computed rows;
+- :mod:`server` — stdlib ``http.server`` JSON endpoint
+  (``/embed``, ``/healthz``, ``/stats``) — no new runtime dependency.
+
+See ``docs/SERVING.md`` for the API contract and bench methodology
+(``scripts/serve_bench.py``).
+"""
+
+from simclr_pytorch_distributed_tpu.serve.batcher import (  # noqa: F401
+    DynamicBatcher,
+    QueueFull,
+    RequestTimeout,
+)
+from simclr_pytorch_distributed_tpu.serve.cache import EmbeddingCache  # noqa: F401
+from simclr_pytorch_distributed_tpu.serve.engine import EmbeddingEngine  # noqa: F401

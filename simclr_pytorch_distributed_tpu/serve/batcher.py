@@ -1,0 +1,302 @@
+"""DynamicBatcher — async request queue + micro-batching worker.
+
+Serving traffic arrives as many small concurrent requests, but the engine's
+throughput comes from large batches (the per-dispatch overhead and the
+padded-bucket waste both amortize with batch size). The batcher bridges the
+two: ``submit(images)`` returns a ``concurrent.futures.Future`` immediately,
+and a single worker thread coalesces queued requests into one engine call
+under two knobs:
+
+- ``max_batch`` — dispatch as soon as the coalesced batch would exceed it;
+- ``max_wait_ms`` — never hold the FIRST request of a batch longer than this
+  (the latency the batcher is allowed to add hunting for batch-mates).
+
+Backpressure is explicit: the queue is bounded BOTH in requests
+(``max_queue``) and in total queued image rows (``max_queue_images`` —
+request count alone would let a burst of large batches hold gigabytes of
+pixels), and a full queue REJECTS new submits with :class:`QueueFull`
+instead of growing without bound — an overloaded server answers 503 now rather than OOMing
+later (serve/server.py maps it). Per-request timeouts (``timeout_ms``)
+expire stale work at dequeue time with :class:`RequestTimeout` so a deep
+queue cannot burn engine cycles on answers nobody is waiting for.
+
+Time is read through an injectable ``clock`` (default ``time.monotonic``);
+deadline logic never touches the wall clock directly, so tests drive
+``max_wait_ms``/timeout expiry with a fake clock instead of sleeping
+(tests/test_serve_batcher.py). ``close()`` drains in-flight work by default.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+
+class QueueFull(RuntimeError):
+    """Bounded-queue backpressure: the submit was rejected, not queued."""
+
+
+class RequestTimeout(TimeoutError):
+    """The request's ``timeout_ms`` expired before the worker reached it."""
+
+
+@dataclass
+class _Request:
+    images: np.ndarray
+    n: int
+    future: Future = field(default_factory=Future)
+    deadline: Optional[float] = None  # clock() value; None = no timeout
+
+
+class DynamicBatcher:
+    def __init__(
+        self,
+        embed_fn: Callable[[np.ndarray], np.ndarray],
+        *,
+        max_batch: int = 128,
+        max_wait_ms: float = 5.0,
+        max_queue: int = 256,
+        max_queue_images: int = 8192,
+        default_timeout_ms: Optional[float] = None,
+        validate: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        poll_interval: float = 0.002,
+        start: bool = True,
+    ):
+        if max_batch < 1 or max_queue < 1 or max_queue_images < 1:
+            raise ValueError(
+                "max_batch, max_queue, and max_queue_images must be >= 1"
+            )
+        self._embed_fn = embed_fn
+        self._max_batch = int(max_batch)
+        self._max_wait_s = float(max_wait_ms) / 1e3
+        self._max_queue = int(max_queue)
+        # request COUNT alone doesn't bound memory — 256 pending requests of
+        # large batches is gigabytes of pixels; cap total queued rows too
+        self._max_queue_images = int(max_queue_images)
+        self._pending_images = 0
+        # optional synchronous request gate (e.g. the engine's geometry
+        # check): bad requests fail at submit() instead of poisoning a
+        # coalesced batch in the worker
+        self._validate = validate
+        self._default_timeout_ms = default_timeout_ms
+        self._clock = clock
+        # real-time condition-wait granularity inside the coalescing window;
+        # deadlines themselves are computed from ``clock`` so a fake clock
+        # controls WHEN the window closes, polling only bounds how fast the
+        # worker notices
+        self._poll = float(poll_interval)
+        self._cond = threading.Condition()
+        self._pending: "deque[_Request]" = deque()
+        self._closed = False
+        self._stats = {
+            "submitted": 0,
+            "rejected": 0,
+            "timeouts": 0,
+            "batches": 0,
+            "batched_images": 0,
+            "errors": 0,
+            "max_queue_depth": 0,
+            "max_batch_observed": 0,
+        }
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._worker, name="dynamic-batcher", daemon=True
+            )
+            self._thread.start()
+
+    # ------------------------------------------------------------- client
+
+    def submit(
+        self, images: np.ndarray, timeout_ms: Optional[float] = None
+    ) -> Future:
+        """Enqueue one request; resolves to float32 ``[n, dim]`` embeddings.
+
+        Raises :class:`QueueFull` when ``max_queue`` requests are already
+        waiting (backpressure — retry later) and ``RuntimeError`` after
+        ``close()``. The future fails with :class:`RequestTimeout` if the
+        worker cannot reach the request within its timeout.
+        """
+        images = np.asarray(images)
+        if images.ndim != 4:
+            raise ValueError(f"expected [n, H, W, C] images, got {images.shape}")
+        n = images.shape[0]
+        if n < 1:
+            raise ValueError("empty request")
+        if self._validate is not None:
+            images = self._validate(images)
+        if timeout_ms is None:
+            timeout_ms = self._default_timeout_ms
+        req = _Request(
+            images=images,
+            n=n,
+            deadline=(self._clock() + timeout_ms / 1e3)
+            if timeout_ms is not None else None,
+        )
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("DynamicBatcher is closed")
+            if len(self._pending) >= self._max_queue:
+                self._stats["rejected"] += 1
+                raise QueueFull(
+                    f"request queue full ({self._max_queue} pending requests)"
+                )
+            if self._pending_images + n > self._max_queue_images:
+                self._stats["rejected"] += 1
+                raise QueueFull(
+                    f"request queue full ({self._pending_images} images "
+                    f"pending, row cap {self._max_queue_images})"
+                )
+            self._pending.append(req)
+            self._pending_images += n
+            self._stats["submitted"] += 1
+            self._stats["max_queue_depth"] = max(
+                self._stats["max_queue_depth"], len(self._pending)
+            )
+            self._cond.notify_all()
+        return req.future
+
+    def close(self, drain: bool = True) -> None:
+        """Stop accepting submits; by default the worker finishes everything
+        already queued before exiting (``drain=False`` fails queued requests
+        immediately). With no worker thread (``start=False``) there is
+        nobody to drain — queued requests are failed either way rather than
+        leaving their futures hanging forever."""
+        with self._cond:
+            self._closed = True
+            if not drain or self._thread is None:
+                while self._pending:
+                    req = self._pending.popleft()
+                    self._pending_images -= req.n
+                    self._fail(req, RuntimeError("DynamicBatcher closed"))
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def stats(self) -> dict:
+        with self._cond:
+            s = dict(self._stats)
+            s["queue_depth"] = len(self._pending)
+            s["queued_images"] = self._pending_images
+        s["max_batch"] = self._max_batch
+        s["max_wait_ms"] = self._max_wait_s * 1e3
+        s["max_queue"] = self._max_queue
+        s["max_queue_images"] = self._max_queue_images
+        if s["batches"]:
+            s["avg_batch_images"] = s["batched_images"] / s["batches"]
+        return s
+
+    # ------------------------------------------------------------- worker
+
+    def _fail(self, req: _Request, exc: Exception) -> None:
+        try:
+            req.future.set_exception(exc)
+        except InvalidStateError:
+            pass  # cancelled by the caller — nothing to deliver
+
+    def _pop_live_locked(self) -> Optional[_Request]:
+        """Next unexpired, uncancelled request; expired ones fail in place."""
+        while self._pending:
+            req = self._pending.popleft()
+            self._pending_images -= req.n
+            if req.future.cancelled():
+                continue
+            if req.deadline is not None and self._clock() > req.deadline:
+                self._stats["timeouts"] += 1
+                self._fail(req, RequestTimeout(
+                    "request expired before the batcher reached it"
+                ))
+                continue
+            return req
+        return None
+
+    def _next_batch(self):
+        """Block for the next micro-batch; ``None`` means closed-and-drained."""
+        with self._cond:
+            while True:
+                req = self._pop_live_locked()
+                if req is not None:
+                    break
+                if self._closed:
+                    return None
+                self._cond.wait(0.05)
+            batch = [req]
+            total = req.n
+            window_end = self._clock() + self._max_wait_s
+            shape = req.images.shape[1:]
+            dtype = req.images.dtype
+            while total < self._max_batch:
+                if self._pending:
+                    nxt = self._pending[0]
+                    if nxt.future.cancelled():
+                        self._pending.popleft()
+                        self._pending_images -= nxt.n
+                        continue
+                    if nxt.deadline is not None and self._clock() > nxt.deadline:
+                        self._pending.popleft()
+                        self._pending_images -= nxt.n
+                        self._stats["timeouts"] += 1
+                        self._fail(nxt, RequestTimeout(
+                            "request expired before the batcher reached it"
+                        ))
+                        continue
+                    if nxt.images.shape[1:] != shape or nxt.images.dtype != dtype:
+                        # incompatible with this batch's geometry: dispatching
+                        # together would fail EVERY waiter on the concatenate;
+                        # leave it to lead the next (same-shape) batch
+                        break
+                    if total + nxt.n > self._max_batch:
+                        break  # would overflow; leave it for the next batch
+                    self._pending.popleft()
+                    self._pending_images -= nxt.n
+                    batch.append(nxt)
+                    total += nxt.n
+                    continue
+                if self._closed or self._clock() >= window_end:
+                    break
+                self._cond.wait(self._poll)
+        return batch
+
+    def _dispatch(self, batch) -> None:
+        total = sum(r.n for r in batch)
+        images = (
+            batch[0].images if len(batch) == 1
+            else np.concatenate([r.images for r in batch], axis=0)
+        )
+        try:
+            emb = self._embed_fn(images)
+        except Exception as exc:  # noqa: BLE001 — delivered to every waiter
+            with self._cond:
+                self._stats["errors"] += 1
+            for req in batch:
+                self._fail(req, exc)
+            return
+        with self._cond:
+            self._stats["batches"] += 1
+            self._stats["batched_images"] += total
+            self._stats["max_batch_observed"] = max(
+                self._stats["max_batch_observed"], total
+            )
+        offset = 0
+        for req in batch:
+            rows = emb[offset:offset + req.n]
+            offset += req.n
+            try:
+                req.future.set_result(rows)
+            except InvalidStateError:
+                pass  # cancelled mid-flight
+
+    def _worker(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            self._dispatch(batch)

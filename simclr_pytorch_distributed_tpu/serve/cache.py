@@ -1,0 +1,77 @@
+"""Content-keyed LRU result cache for computed embedding rows.
+
+Serving traffic is heavy-tailed: the same images (popular items, retry
+storms, deduplicated uploads) recur far more often than a uniform draw would
+suggest, and a ResNet forward is ~10^8 FLOPs per image while a hash of the
+raw uint8 bytes is ~10^3 — so a small LRU in front of the engine converts
+repeat traffic into O(hash) lookups. Keys are content hashes of the raw
+image bytes (plus the engine's preprocessing fingerprint, see
+``EmbeddingEngine._cache_key``), so two byte-identical images always share an
+entry regardless of which request they arrived in.
+
+Thread-safe: the batcher worker writes while HTTP stats readers poll
+counters. Stored rows are frozen (``writeable=False``) so a caller mutating a
+returned row cannot poison later hits.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+
+class EmbeddingCache:
+    """Bounded LRU of ``key -> embedding row`` with hit/miss/eviction counters."""
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._data: "OrderedDict[bytes, np.ndarray]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+
+    def get(self, key: bytes) -> Optional[np.ndarray]:
+        with self._lock:
+            row = self._data.get(key)
+            if row is None:
+                self._misses += 1
+                return None
+            self._data.move_to_end(key)
+            self._hits += 1
+            return row
+
+    def put(self, key: bytes, row: np.ndarray) -> None:
+        frozen = np.array(row, copy=True)
+        frozen.setflags(write=False)
+        with self._lock:
+            self._data[key] = frozen
+            self._data.move_to_end(key)
+            while len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+                self._evictions += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self._hits + self._misses
+            return {
+                "entries": len(self._data),
+                "capacity": self.capacity,
+                "hits": self._hits,
+                "misses": self._misses,
+                "evictions": self._evictions,
+                "hit_rate": (self._hits / total) if total else 0.0,
+            }

@@ -1,0 +1,264 @@
+"""Stdlib HTTP JSON endpoint over the engine + batcher — no new runtime deps.
+
+Endpoints:
+
+- ``POST /embed`` — body ``{"images": [[...]]}`` (nested uint8 lists) or
+  ``{"images_b64": "<base64 raw bytes>", "shape": [n, h, w, 3]}``; optional
+  ``"timeout_ms"``. Replies ``{"embeddings": [[...]], "dim": D, "n": N}``.
+- ``GET /healthz`` — liveness: ``{"status": "ok"}``.
+- ``GET /stats``  — engine/batcher/cache counters (the observability the
+  bench and operators read).
+
+Status mapping makes the backpressure contract visible on the wire:
+``QueueFull`` -> **503** (+ ``Retry-After``), a request/future timeout ->
+**504**, malformed input -> **400**. ``ThreadingHTTPServer`` gives one
+thread per connection, which is exactly what the DynamicBatcher wants:
+concurrent handlers all block on their own futures while the worker thread
+coalesces their requests into shared engine batches.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import json
+import logging
+import threading
+from concurrent.futures import TimeoutError as FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from simclr_pytorch_distributed_tpu.serve.batcher import (
+    DynamicBatcher,
+    QueueFull,
+    RequestTimeout,
+)
+
+logger = logging.getLogger(__name__)
+
+MAX_BODY_BYTES = 64 * 1024 * 1024  # one request can't OOM the server
+
+
+def _decode_images(payload: dict) -> np.ndarray:
+    if "images_b64" in payload:
+        shape = payload.get("shape")
+        if not isinstance(shape, (list, tuple)) or len(shape) != 4:
+            raise ValueError("images_b64 requires 'shape': [n, h, w, c]")
+        try:
+            raw = base64.b64decode(payload["images_b64"], validate=True)
+        except (binascii.Error, TypeError) as e:
+            raise ValueError(f"invalid base64 image payload: {e}")
+        shape = tuple(int(s) for s in shape)
+        expect = int(np.prod(shape))
+        if len(raw) != expect:
+            raise ValueError(
+                f"payload is {len(raw)} bytes but shape {shape} needs {expect}"
+            )
+        return np.frombuffer(raw, np.uint8).reshape(shape)
+    if "images" in payload:
+        arr = np.asarray(payload["images"])
+        if arr.dtype.kind not in "iuf":
+            raise ValueError(f"non-numeric image payload ({arr.dtype})")
+        if arr.ndim != 4:
+            raise ValueError(f"expected [n, h, w, c] images, got shape {arr.shape}")
+        if arr.min() < 0 or arr.max() > 255:
+            raise ValueError("pixel values must be uint8 (0..255)")
+        return arr.astype(np.uint8)
+    raise ValueError("body must carry 'images' or 'images_b64'+'shape'")
+
+
+def make_handler(batcher: DynamicBatcher, stats_fn, *, result_timeout_s: float = 30.0):
+    """Build the request-handler class bound to one batcher.
+
+    ``stats_fn`` is any ``() -> dict`` (the engine's ``stats``, wrapped to
+    merge batcher/cache views); keeping it a callable means the handler —
+    and its tests — need no engine at all.
+    """
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, code: int, obj: dict, extra_headers=()) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in extra_headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            if self.path == "/healthz":
+                self._reply(200, {"status": "ok"})
+            elif self.path == "/stats":
+                self._reply(200, stats_fn())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/embed":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            if length <= 0 or length > MAX_BODY_BYTES:
+                # replying WITHOUT reading the body would leave its bytes in
+                # the keep-alive stream to be parsed as the next request —
+                # advertise and perform a connection close so the protocol
+                # can't desync (send_header('Connection','close') also sets
+                # self.close_connection)
+                self._reply(400, {"error": f"bad Content-Length {length}"},
+                            [("Connection", "close")])
+                return
+            try:
+                payload = json.loads(self.rfile.read(length))
+                images = _decode_images(payload)
+                timeout_ms = payload.get("timeout_ms")
+                if timeout_ms is not None and (
+                    not isinstance(timeout_ms, (int, float))
+                    or isinstance(timeout_ms, bool) or timeout_ms <= 0
+                ):
+                    raise ValueError(
+                        f"timeout_ms must be a positive number, "
+                        f"got {timeout_ms!r}"
+                    )
+            except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": str(e)})
+                return
+            try:
+                future = batcher.submit(images, timeout_ms=timeout_ms)
+            except QueueFull as e:
+                # the explicit backpressure signal: better a retryable 503
+                # now than an unbounded queue later
+                self._reply(503, {"error": str(e)}, [("Retry-After", "1")])
+                return
+            except ValueError as e:
+                self._reply(400, {"error": str(e)})
+                return
+            except RuntimeError as e:
+                # batcher closed (shutdown race): the request was VALID —
+                # tell the client to retry elsewhere, not that it's malformed
+                self._reply(503, {"error": str(e)})
+                return
+            try:
+                emb = future.result(
+                    timeout=(timeout_ms / 1e3) if timeout_ms is not None
+                    else result_timeout_s
+                )
+            except (RequestTimeout, FutureTimeout) as e:
+                future.cancel()
+                self._reply(504, {"error": f"embedding timed out: {e}"})
+                return
+            except Exception as e:  # noqa: BLE001 — engine failure -> 500
+                self._reply(500, {"error": str(e)})
+                return
+            self._reply(
+                200,
+                {
+                    "embeddings": [row.tolist() for row in emb],
+                    "dim": int(emb.shape[1]),
+                    "n": int(emb.shape[0]),
+                },
+            )
+
+        def log_message(self, fmt, *args):  # quiet: route through logging
+            logger.debug("%s - %s", self.address_string(), fmt % args)
+
+    return Handler
+
+
+def create_server(
+    batcher: DynamicBatcher, stats_fn, host: str = "127.0.0.1", port: int = 8000,
+    result_timeout_s: float = 30.0,
+) -> ThreadingHTTPServer:
+    handler = make_handler(batcher, stats_fn, result_timeout_s=result_timeout_s)
+    server = ThreadingHTTPServer((host, port), handler)
+    server.daemon_threads = True
+    return server
+
+
+def start_in_thread(server: ThreadingHTTPServer) -> threading.Thread:
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return t
+
+
+def combined_stats_fn(engine, batcher: DynamicBatcher):
+    def stats():
+        return {"engine": engine.stats(), "batcher": batcher.stats()}
+
+    return stats
+
+
+def main(argv=None):
+    import argparse
+
+    from simclr_pytorch_distributed_tpu.serve.cache import EmbeddingCache
+    from simclr_pytorch_distributed_tpu.serve.engine import (
+        DEFAULT_BUCKETS,
+        EmbeddingEngine,
+    )
+
+    p = argparse.ArgumentParser(
+        description="batched embedding-inference HTTP server "
+                    "(POST /embed, GET /healthz, GET /stats)"
+    )
+    p.add_argument("--ckpt", default="",
+                   help="checkpoint/run dir or reference .pth; empty = "
+                        "random-init --model (smoke/bench)")
+    p.add_argument("--model", default="resnet10",
+                   help="architecture for random init when --ckpt is empty")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--buckets", default=",".join(map(str, DEFAULT_BUCKETS)),
+                   help="comma-separated jit batch buckets")
+    p.add_argument("--max_batch", type=int, default=128)
+    p.add_argument("--max_wait_ms", type=float, default=5.0)
+    p.add_argument("--max_queue", type=int, default=256)
+    p.add_argument("--img_size", type=int, default=None,
+                   help="pinned request H=W (default: the checkpoint "
+                        "config's --size, else 32); mismatched requests "
+                        "get 400 instead of a fresh compile")
+    p.add_argument("--normalize", action="store_true",
+                   help="L2-normalize embeddings (ops/losses.py contract)")
+    p.add_argument("--output", default="features",
+                   choices=["features", "projection"])
+    p.add_argument("--cache_capacity", type=int, default=4096,
+                   help="content-keyed LRU rows; 0 disables the cache")
+    args = p.parse_args(argv)
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    cache = EmbeddingCache(args.cache_capacity) if args.cache_capacity else None
+    kwargs = dict(buckets=buckets, normalize=args.normalize,
+                  output=args.output, cache=cache)
+    if args.img_size is not None:
+        kwargs["img_size"] = args.img_size
+    if args.ckpt:
+        engine = EmbeddingEngine.from_checkpoint(args.ckpt, **kwargs)
+    else:
+        logging.warning("--ckpt not given: serving a RANDOM %s", args.model)
+        engine = EmbeddingEngine.random_init(
+            model_name=args.model, size=kwargs.get("img_size", 32), **kwargs
+        )
+    batcher = DynamicBatcher(
+        engine.embed, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        max_queue=args.max_queue,
+        # geometry mismatches fail the submit (-> 400), never a worker batch
+        validate=engine.validate_images,
+    )
+    server = create_server(batcher, combined_stats_fn(engine, batcher),
+                           host=args.host, port=args.port)
+    logging.info("serving %s embeddings on http://%s:%d",
+                 engine.model.model_name, args.host, args.port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        batcher.close()
+
+
+if __name__ == "__main__":
+    main()

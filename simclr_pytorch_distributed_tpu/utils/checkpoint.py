@@ -343,18 +343,12 @@ def load_classifier(path: str, abstract_params):
     )["params"]
 
 
-def load_pretrained_variables(path: str, abstract_variables: dict) -> dict:
-    """Model-variables-only load: pretrain warm-start (main_supcon.py:216-220)
-    and the probe's encoder restore (main_linear.py:125-142). Accepts a run
-    directory too (resolved to its latest complete checkpoint), so ``--ckpt``
-    and ``--resume`` take the same kinds of paths. A dir that directly holds a
-    ``model`` payload is used as-is — meta.json completeness only gates FULL
-    resume, not model-only loads (e.g. hand-built encoder checkpoints).
-
-    A reference ``.pth`` file (torch.save layout, util.py:87-96) is accepted
-    directly: it is converted in place to ``<file>.converted/`` on first use
-    (utils/torch_convert.py) and loaded from there — ``--ckpt ref.pth`` just
-    works."""
+def _resolve_model_dir(path: str) -> str:
+    """Resolve any accepted ``--ckpt`` spelling to a dir holding a ``model``
+    payload: a checkpoint dir, a run dir (latest complete checkpoint, with a
+    model-only fallback for payloads whose meta marker never got stamped), or
+    a reference ``.pth`` file (converted in place to ``<file>.converted/`` on
+    first use, utils/torch_convert.py)."""
     path = os.path.abspath(path)
     if os.path.isfile(path):
         out_dir = path + ".converted"
@@ -390,16 +384,68 @@ def load_pretrained_variables(path: str, abstract_variables: dict) -> dict:
                 path = max(subs, key=os.path.getmtime)
             else:
                 raise
-    # The layout check must cover THIS path too — warm-start/probe loads are
-    # the primary way an old encoder gets reused. Bare payload dirs without
-    # meta.json (hand-built) are exempt.
+    return path
+
+
+def _read_meta_and_warn(path: str) -> dict:
+    """Best-effort meta.json read + layout-mismatch warning. Bare payload
+    dirs without meta.json (hand-built) are exempt — returns ``{}``."""
     meta_path = os.path.join(path, META_FILE)
     if os.path.exists(meta_path):
         try:
             with open(meta_path) as f:
-                _warn_layout_mismatch(path, json.load(f))
+                meta = json.load(f)
+            _warn_layout_mismatch(path, meta)
+            return meta
         except ValueError:
             pass
+    return {}
+
+
+def load_model_payload(path: str) -> Tuple[dict, dict]:
+    """Restore a ``model`` payload WITHOUT knowing the architecture up front.
+
+    Unlike :func:`load_pretrained_variables` (which needs an abstract tree
+    built from an already-chosen model), this restores whatever
+    ``{'params', 'batch_stats'}`` tree the checkpoint holds — the serving
+    engine then infers the architecture from the tree itself
+    (``models.heads.infer_architecture_from_variables``), so ``--ckpt`` needs
+    no accompanying ``--model`` flag. Accepts the same path spellings as
+    ``--ckpt`` (checkpoint dir / run dir / reference ``.pth``).
+
+    Returns ``(variables, meta)``; ``meta`` is ``{}`` for bare payload dirs.
+    OWNERSHIP CAVEAT: the arrays are orbax-restored host buffers, NOT
+    re-owned — fine for non-donating consumers (the serving engine
+    device_puts them, which yields fresh arrays anyway), but anything that
+    feeds them into a donating jit must pass them through ``jit_copy_tree``
+    first (see ``restore_checkpoint``'s double-free note).
+    """
+    path = _resolve_model_dir(path)
+    meta = _read_meta_and_warn(path)
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        variables = ckptr.restore(os.path.join(path, "model"))
+    finally:
+        ckptr.close()
+    return variables, meta
+
+
+def load_pretrained_variables(path: str, abstract_variables: dict) -> dict:
+    """Model-variables-only load: pretrain warm-start (main_supcon.py:216-220)
+    and the probe's encoder restore (main_linear.py:125-142). Accepts a run
+    directory too (resolved to its latest complete checkpoint), so ``--ckpt``
+    and ``--resume`` take the same kinds of paths. A dir that directly holds a
+    ``model`` payload is used as-is — meta.json completeness only gates FULL
+    resume, not model-only loads (e.g. hand-built encoder checkpoints).
+
+    A reference ``.pth`` file (torch.save layout, util.py:87-96) is accepted
+    directly: it is converted in place to ``<file>.converted/`` on first use
+    (utils/torch_convert.py) and loaded from there — ``--ckpt ref.pth`` just
+    works."""
+    path = _resolve_model_dir(path)
+    # The layout check must cover THIS path too — warm-start/probe loads are
+    # the primary way an old encoder gets reused.
+    _read_meta_and_warn(path)
     variables = _restore_tree(
         os.path.join(path, "model"),
         _abstract({"params": abstract_variables["params"],

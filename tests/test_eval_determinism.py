@@ -1,0 +1,87 @@
+"""Eval-mode determinism — the invariant the serving buckets depend on.
+
+``SupConResNet.encode(train=False)`` must be (a) bit-stable across calls of
+the same compiled program and (b) per-example independent: row i's output
+cannot depend on rows != i (BN reads running statistics, every other op is
+per-row), so the engine's pad rows are invisible **bitwise** within one
+program. Across DIFFERENT compiled programs (another batch size/sharding)
+XLA may reorder reductions, so the guarantee honestly weakens to float
+tolerance — both halves pinned here at the model level
+(tests/test_serve_engine.py pins them at the engine level).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu.models import SupConResNet
+
+pytestmark = pytest.mark.serve
+
+SIZE = 8
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    model = SupConResNet(model_name="resnet10")
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((2, SIZE, SIZE, 3)), train=False
+    )
+    encode = jax.jit(
+        lambda v, x: model.apply(v, x, train=False, method=SupConResNet.encode)
+    )
+    return model, variables, encode
+
+
+def _images(rng, n):
+    return rng.standard_normal((n, SIZE, SIZE, 3)).astype(np.float32)
+
+
+def test_repeat_calls_bit_identical(model_and_vars):
+    _, v, encode = model_and_vars
+    x = jnp.asarray(_images(np.random.default_rng(0), 4))
+    a = np.asarray(encode(v, x))
+    b = np.asarray(encode(v, x))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_rows_independent_of_pad_content(model_and_vars):
+    """Same compiled program (batch 8): 5 real rows + zero pad vs the SAME 5
+    rows + large garbage pad — the real rows are bit-identical. This is what
+    makes padded-bucket serving exact."""
+    _, v, encode = model_and_vars
+    rng = np.random.default_rng(1)
+    x5 = _images(rng, 5)
+    zeros = np.zeros((3, SIZE, SIZE, 3), np.float32)
+    garbage = _images(rng, 3) * 100.0
+    a = np.asarray(encode(v, jnp.asarray(np.concatenate([x5, zeros]))))[:5]
+    b = np.asarray(encode(v, jnp.asarray(np.concatenate([x5, garbage]))))[:5]
+    np.testing.assert_array_equal(a, b)
+
+
+def test_across_batch_sizes_float_tight(model_and_vars):
+    """A batch of 5 on its own program vs the same 5 padded to 32 on another:
+    per-row agreement to float tolerance (bitwise is only guaranteed within
+    ONE compiled program — measured ~1 ulp drift across programs on CPU)."""
+    _, v, encode = model_and_vars
+    rng = np.random.default_rng(2)
+    x5 = _images(rng, 5)
+    x32 = np.concatenate([x5, np.zeros((27, SIZE, SIZE, 3), np.float32)])
+    a = np.asarray(encode(v, jnp.asarray(x5)))
+    b = np.asarray(encode(v, jnp.asarray(x32)))[:5]
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_eval_mode_does_not_touch_batch_stats(model_and_vars):
+    """train=False must not mutate running statistics — the frozen-encoder
+    contract serving (and the probe) rely on."""
+    model, v, _ = model_and_vars
+    x = jnp.asarray(_images(np.random.default_rng(3), 4) + 3.0)
+    _, mutated = model.apply(
+        v, x, train=False, method=SupConResNet.encode, mutable=["batch_stats"]
+    )
+    for old, new in zip(
+        jax.tree.leaves(v["batch_stats"]), jax.tree.leaves(mutated["batch_stats"])
+    ):
+        np.testing.assert_array_equal(np.asarray(old), np.asarray(new))

@@ -1,0 +1,142 @@
+"""Exit-75 retry contract in the launchers (run_supcon.sh / run_linear.sh).
+
+PR 1 built the preemption machinery (emergency checkpoint + exit 75,
+docs/RESILIENCE.md) but the launchers launched once and exited — the
+contract's "re-run with --resume" half never actually happened. These tests
+run the REAL launcher scripts against a stub ``python`` on PATH that logs
+its argv and scripts the exit codes, proving: bounded retries happen only on
+exit 75, ``--resume`` points at the newest pretrain run dir, and every other
+exit code passes through untouched.
+"""
+
+import os
+import stat
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def write_stub_python(bin_dir, tmp_path, exit_codes, make_run_dir=None):
+    """A fake ``python`` that logs argv, optionally creates a run dir (as a
+    real preempted driver would have), and exits per-invocation codes."""
+    log = tmp_path / "calls.log"
+    codes = " ".join(str(c) for c in exit_codes)
+    mkdir_cmd = f'mkdir -p "{make_run_dir}"' if make_run_dir else ":"
+    stub = bin_dir / "python"
+    stub.write_text(f"""#!/bin/bash
+echo "$@" >> "{log}"
+count=$(wc -l < "{log}")
+{mkdir_cmd}
+codes=({codes})
+exit "${{codes[$((count - 1))]}}"
+""")
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    return log
+
+
+def run_launcher(script, args, bin_dir, tmp_path):
+    env = dict(os.environ, PATH=f"{bin_dir}:{os.environ['PATH']}")
+    return subprocess.run(
+        ["bash", os.path.join(REPO, script), *args],
+        env=env, cwd=tmp_path, capture_output=True, text=True, timeout=60,
+    )
+
+
+@pytest.fixture
+def bin_dir(tmp_path):
+    d = tmp_path / "bin"
+    d.mkdir()
+    return d
+
+
+def test_supcon_retries_with_resume_then_succeeds(tmp_path, bin_dir):
+    workdir = tmp_path / "ws"
+    run_dir = workdir / "cifar10_models" / "cifar10_0101_0000_SimCLR_run"
+    log = write_stub_python(
+        bin_dir, tmp_path, exit_codes=[75, 75, 0], make_run_dir=run_dir
+    )
+    proc = run_launcher(
+        "run_supcon.sh", ["--workdir", str(workdir)], bin_dir, tmp_path
+    )
+    assert proc.returncode == 0, proc.stderr
+    calls = log.read_text().splitlines()
+    assert len(calls) == 3
+    assert "--resume" not in calls[0]
+    for call in calls[1:]:  # every retry resumes from the newest run dir
+        assert f"--resume {run_dir}" in call
+    assert "retry 1/3" in proc.stderr and "retry 2/3" in proc.stderr
+
+
+def test_supcon_ignores_probe_and_ce_dirs_when_resolving_resume(tmp_path, bin_dir):
+    workdir = tmp_path / "ws"
+    pretrain = workdir / "cifar10_models" / "cifar10_0101_0000_SimCLR_run"
+    log = write_stub_python(bin_dir, tmp_path, [75, 0], make_run_dir=pretrain)
+    # decoys that sort NEWER than the pretrain dir must not win
+    far_future = 4102444800  # newer than any mtime the stub's mkdir produces
+    for decoy in ("classifier_0102_0000_foo", "ce_0102_0000_bar"):
+        d = workdir / "cifar10_models" / decoy
+        d.mkdir(parents=True)
+        os.utime(d, (far_future, far_future))
+    proc = run_launcher(
+        "run_supcon.sh", ["--workdir", str(workdir)], bin_dir, tmp_path
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert f"--resume {pretrain}" in log.read_text().splitlines()[1]
+
+
+def test_supcon_retry_resume_beats_user_supplied_resume(tmp_path, bin_dir):
+    """argparse is last-wins: on a retry the freshly resolved run dir must
+    come AFTER any --resume the user passed, or every retry would restart
+    from the user's stale checkpoint and lose the preempted progress."""
+    workdir = tmp_path / "ws"
+    run_dir = workdir / "cifar10_models" / "cifar10_0101_0000_SimCLR_run"
+    log = write_stub_python(bin_dir, tmp_path, [75, 0], make_run_dir=run_dir)
+    proc = run_launcher(
+        "run_supcon.sh",
+        ["--workdir", str(workdir), "--resume", "stale_dir"],
+        bin_dir, tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr
+    retry = log.read_text().splitlines()[1]
+    assert retry.index("--resume stale_dir") < retry.index(f"--resume {run_dir}")
+
+
+def test_supcon_honors_workdir_equals_spelling(tmp_path, bin_dir):
+    """argparse accepts '--workdir=DIR'; the launcher's resume scan must too
+    — otherwise a retry silently restarts from scratch in ./work_space."""
+    workdir = tmp_path / "ce_experiments" / "ws"  # also: '/ce_' IN the path
+    run_dir = workdir / "cifar10_models" / "cifar10_0101_0000_SimCLR_run"
+    log = write_stub_python(bin_dir, tmp_path, [75, 0], make_run_dir=run_dir)
+    proc = run_launcher(
+        "run_supcon.sh", [f"--workdir={workdir}"], bin_dir, tmp_path
+    )
+    assert proc.returncode == 0, proc.stderr
+    # the basename filter must not be fooled by 'ce_' in the workdir path
+    assert f"--resume {run_dir}" in log.read_text().splitlines()[1]
+
+
+def test_supcon_non_75_exit_passes_through_without_retry(tmp_path, bin_dir):
+    log = write_stub_python(bin_dir, tmp_path, exit_codes=[3])
+    proc = run_launcher("run_supcon.sh", [], bin_dir, tmp_path)
+    assert proc.returncode == 3
+    assert len(log.read_text().splitlines()) == 1  # no retry
+
+
+def test_supcon_retries_are_bounded(tmp_path, bin_dir):
+    log = write_stub_python(bin_dir, tmp_path, exit_codes=[75] * 10)
+    proc = run_launcher("run_supcon.sh", [], bin_dir, tmp_path)
+    assert proc.returncode == 75  # still preempted after the budget: honest rc
+    assert len(log.read_text().splitlines()) == 4  # 1 launch + PREEMPT_RETRIES=3
+
+
+def test_linear_retries_from_scratch_then_passes_through(tmp_path, bin_dir):
+    log = write_stub_python(bin_dir, tmp_path, exit_codes=[75, 2])
+    proc = run_launcher("run_linear.sh", ["--ckpt", "x"], bin_dir, tmp_path)
+    assert proc.returncode == 2  # second run's code passes through
+    calls = log.read_text().splitlines()
+    assert len(calls) == 2
+    assert "--resume" not in calls[0]
+    assert "--resume preempted-retry" in calls[1]  # probe: retrain from scratch
+    assert "--ckpt x" in calls[1]  # user args survive the relaunch

@@ -1,0 +1,281 @@
+"""Unit tests for serve/batcher.py — coalescing policy, backpressure,
+timeouts, draining.
+
+No jax here: the engine is a fake per-row map (row i of the result identifies
+image i), which makes "each request got exactly ITS rows back" checkable
+after any batching the worker chose to do. Deadline logic runs on an
+injected fake clock — no test sleeps longer than the worker's poll
+granularity (a few ms).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu.serve.batcher import (
+    DynamicBatcher,
+    QueueFull,
+    RequestTimeout,
+)
+
+pytestmark = pytest.mark.serve
+
+H = W = 2  # tiny "images"; the fake engine only hashes rows
+
+
+def fake_embed(images):
+    """Per-row map: embedding = [sum of the image's pixels]."""
+    images = np.asarray(images)
+    return images.reshape(len(images), -1).sum(axis=1, keepdims=True).astype(np.float32)
+
+
+def imgs(*values):
+    """One image per value, every pixel = value -> row sum identifies it."""
+    out = np.zeros((len(values), H, W, 3), np.uint8)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ------------------------------------------------------------ policy (no thread)
+
+
+def test_coalesces_pending_requests_into_one_batch():
+    b = DynamicBatcher(fake_embed, max_batch=8, max_wait_ms=50, start=False)
+    futs = [b.submit(imgs(1, 2)), b.submit(imgs(3)), b.submit(imgs(4, 5, 6))]
+    batch = b._next_batch()
+    assert [r.n for r in batch] == [2, 1, 3]  # all coalesced, FIFO order
+    b._dispatch(batch)
+    np.testing.assert_array_equal(
+        futs[0].result(0), fake_embed(imgs(1, 2))
+    )
+    np.testing.assert_array_equal(futs[1].result(0), fake_embed(imgs(3)))
+    np.testing.assert_array_equal(futs[2].result(0), fake_embed(imgs(4, 5, 6)))
+    s = b.stats()
+    assert s["batches"] == 1 and s["batched_images"] == 6
+
+
+def test_max_batch_splits_but_never_splits_a_request():
+    b = DynamicBatcher(fake_embed, max_batch=8, max_wait_ms=50, start=False)
+    b.submit(imgs(*range(3)))
+    b.submit(imgs(*range(3)))
+    b.submit(imgs(*range(3)))  # 3+3+3 > 8: third rides the next batch
+    first = b._next_batch()
+    assert [r.n for r in first] == [3, 3]
+    second = b._next_batch()
+    assert [r.n for r in second] == [3]
+
+
+def test_oversize_request_dispatches_alone():
+    b = DynamicBatcher(fake_embed, max_batch=4, max_wait_ms=50, start=False)
+    fut = b.submit(imgs(*range(10)))  # bigger than max_batch: engine chunks it
+    b.submit(imgs(1))
+    batch = b._next_batch()
+    assert [r.n for r in batch] == [10]
+    b._dispatch(batch)
+    assert fut.result(0).shape == (10, 1)
+
+
+def test_backpressure_rejects_with_queue_full():
+    b = DynamicBatcher(fake_embed, max_batch=8, max_queue=3, start=False)
+    for _ in range(3):
+        b.submit(imgs(1))
+    with pytest.raises(QueueFull):
+        b.submit(imgs(2))
+    assert b.stats()["rejected"] == 1
+    assert b.stats()["queue_depth"] == 3  # the queue did NOT grow
+
+
+def test_backpressure_bounds_queued_rows_not_just_requests():
+    """Request count alone doesn't bound memory: a few large-batch requests
+    must trip QueueFull via the row cap."""
+    b = DynamicBatcher(fake_embed, max_batch=8, max_queue=100,
+                       max_queue_images=10, start=False)
+    b.submit(imgs(*range(6)))
+    with pytest.raises(QueueFull, match="row cap"):
+        b.submit(imgs(*range(5)))  # 6 + 5 > 10
+    b.submit(imgs(*range(4)))  # 6 + 4 == 10: still admitted
+    assert b.stats()["queued_images"] == 10
+    # dispatching frees the budget: the 6-row request goes alone (6+4 would
+    # exceed max_batch=8), leaving the 4-row one queued
+    b._dispatch(b._next_batch())
+    assert b.stats()["queued_images"] == 4
+    b.submit(imgs(*range(6)))  # 4 + 6 == 10: fits again
+
+
+def test_validate_hook_rejects_at_submit():
+    """A request gate (the engine's geometry check) fails bad submits
+    synchronously — the worker and its batch-mates never see them."""
+    def gate(images):
+        if images.shape[1] != H:
+            raise ValueError("wrong geometry")
+        return images
+
+    b = DynamicBatcher(fake_embed, validate=gate, start=False)
+    b.submit(imgs(1))
+    with pytest.raises(ValueError, match="wrong geometry"):
+        b.submit(np.zeros((1, H + 1, W, 3), np.uint8))
+    assert b.stats()["submitted"] == 1  # the bad request was never queued
+
+
+def test_expired_request_fails_with_timeout_on_fake_clock():
+    clock = FakeClock()
+    # max_wait_ms=0: the coalescing window closes instantly — on a fake
+    # clock a nonzero window would never elapse without another advance()
+    b = DynamicBatcher(fake_embed, max_batch=8, max_wait_ms=0, clock=clock,
+                       start=False)
+    stale = b.submit(imgs(1), timeout_ms=1000)
+    live = b.submit(imgs(2))  # no timeout
+    clock.advance(2.0)  # stale's deadline passes without any real sleep
+    batch = b._next_batch()
+    assert [r.n for r in batch] == [1] and batch[0].future is live
+    with pytest.raises(RequestTimeout):
+        stale.result(0)
+    assert b.stats()["timeouts"] == 1
+
+
+def test_expired_request_mid_queue_does_not_drop_its_neighbor():
+    """Regression: discarding an expired request during coalescing must not
+    swallow the live request behind it (the discard helper used to pop AND
+    return the neighbor, which the call site threw away — its future then
+    hung forever)."""
+    clock = FakeClock()
+    b = DynamicBatcher(fake_embed, max_batch=8, max_wait_ms=0, clock=clock,
+                       start=False)
+    a = b.submit(imgs(1))                      # live head
+    stale = b.submit(imgs(2), timeout_ms=500)  # expires mid-queue
+    c = b.submit(imgs(3))                      # live tail — must NOT be lost
+    clock.advance(1.0)
+    batch = b._next_batch()
+    assert [r.future for r in batch] == [a, c]
+    b._dispatch(batch)
+    np.testing.assert_array_equal(a.result(0), fake_embed(imgs(1)))
+    np.testing.assert_array_equal(c.result(0), fake_embed(imgs(3)))
+    with pytest.raises(RequestTimeout):
+        stale.result(0)
+
+
+def test_mixed_shapes_split_into_separate_batches():
+    """One odd-shaped request must not poison its batch-mates: requests whose
+    image geometry differs from the batch head's are deferred to lead their
+    own batch, and ALL of them succeed."""
+    b = DynamicBatcher(fake_embed, max_batch=8, max_wait_ms=0, start=False)
+    small = b.submit(imgs(1))
+    big = b.submit(np.full((1, 4, 4, 3), 7, np.uint8))  # different H/W
+    first = b._next_batch()
+    assert [r.future for r in first] == [small]
+    second = b._next_batch()
+    assert [r.future for r in second] == [big]
+    b._dispatch(first)
+    b._dispatch(second)
+    np.testing.assert_array_equal(small.result(0), fake_embed(imgs(1)))
+    np.testing.assert_array_equal(
+        big.result(0), fake_embed(np.full((1, 4, 4, 3), 7, np.uint8))
+    )
+
+
+# ------------------------------------------------------- worker thread (live)
+
+
+def test_live_roundtrip_and_close_drains():
+    b = DynamicBatcher(fake_embed, max_batch=8, max_wait_ms=5)
+    futs = [b.submit(imgs(i)) for i in range(6)]
+    b.close()  # drains everything queued before returning
+    for i, fut in enumerate(futs):
+        np.testing.assert_array_equal(fut.result(0), fake_embed(imgs(i)))
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit(imgs(0))
+    s = b.stats()
+    assert s["batched_images"] == 6 and s["batches"] <= 6
+
+
+def test_close_without_drain_fails_pending():
+    b = DynamicBatcher(fake_embed, max_batch=8, start=False)
+    fut = b.submit(imgs(1))
+    b.close(drain=False)
+    with pytest.raises(RuntimeError, match="closed"):
+        fut.result(0)
+
+
+def test_close_with_no_worker_fails_pending_even_when_draining():
+    """Regression: drain=True with start=False has nobody to drain — the
+    queued future must fail instead of hanging its waiter forever."""
+    b = DynamicBatcher(fake_embed, max_batch=8, start=False)
+    fut = b.submit(imgs(1))
+    b.close(drain=True)
+    with pytest.raises(RuntimeError, match="closed"):
+        fut.result(0)
+
+
+def test_fake_clock_controls_the_coalescing_window():
+    """With max_wait_ms=10s on a fake clock, a lone request dispatches only
+    after the CLOCK passes the window — in a few real milliseconds."""
+    clock = FakeClock()
+    b = DynamicBatcher(fake_embed, max_batch=8, max_wait_ms=10_000,
+                       clock=clock, poll_interval=0.001)
+    try:
+        fut = b.submit(imgs(3))
+        time.sleep(0.03)  # worker is inside the window, holding the request
+        assert not fut.done()
+        clock.advance(11.0)  # close the window; no real 10 s elapses
+        np.testing.assert_array_equal(
+            fut.result(timeout=2), fake_embed(imgs(3))
+        )
+    finally:
+        b.close()
+
+
+def test_engine_error_propagates_to_every_waiter():
+    def broken(images):
+        raise ValueError("engine exploded")
+
+    b = DynamicBatcher(broken, max_batch=8, max_wait_ms=5)
+    try:
+        futs = [b.submit(imgs(1)), b.submit(imgs(2))]
+        for fut in futs:
+            with pytest.raises(ValueError, match="engine exploded"):
+                fut.result(timeout=2)
+        assert b.stats()["errors"] >= 1
+    finally:
+        b.close()
+
+
+def test_concurrent_submitters_all_get_their_rows():
+    b = DynamicBatcher(fake_embed, max_batch=16, max_wait_ms=5)
+    results = {}
+    lock = threading.Lock()
+
+    def client(i):
+        out = b.submit(imgs(i, i)).result(timeout=5)
+        with lock:
+            results[i] = out
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    b.close()
+    for i in range(8):
+        np.testing.assert_array_equal(results[i], fake_embed(imgs(i, i)))
+
+
+def test_submit_validation():
+    b = DynamicBatcher(fake_embed, start=False)
+    with pytest.raises(ValueError):
+        b.submit(np.zeros((4, 4, 3), np.uint8))  # missing batch dim
+    with pytest.raises(ValueError):
+        b.submit(np.zeros((0, 4, 4, 3), np.uint8))  # empty

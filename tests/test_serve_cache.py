@@ -1,0 +1,65 @@
+"""Unit tests for serve/cache.py — the content-keyed LRU result cache."""
+
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu.serve.cache import EmbeddingCache
+
+pytestmark = pytest.mark.serve
+
+
+def row(v, dim=4):
+    return np.full((dim,), v, np.float32)
+
+
+def test_put_get_and_counters():
+    c = EmbeddingCache(capacity=8)
+    assert c.get(b"a") is None  # miss
+    c.put(b"a", row(1.0))
+    got = c.get(b"a")
+    np.testing.assert_array_equal(got, row(1.0))
+    s = c.stats()
+    assert s["hits"] == 1 and s["misses"] == 1 and s["entries"] == 1
+    assert s["hit_rate"] == 0.5
+
+
+def test_lru_eviction_order_respects_access():
+    c = EmbeddingCache(capacity=2)
+    c.put(b"a", row(1))
+    c.put(b"b", row(2))
+    assert c.get(b"a") is not None  # refresh 'a' — 'b' is now oldest
+    c.put(b"c", row(3))  # evicts 'b'
+    assert c.get(b"b") is None
+    assert c.get(b"a") is not None and c.get(b"c") is not None
+    assert c.stats()["evictions"] == 1
+    assert len(c) == 2
+
+
+def test_stored_rows_are_frozen():
+    """A caller mutating its input after put, or the returned row after get,
+    must not poison later hits."""
+    c = EmbeddingCache(capacity=4)
+    src = row(1.0)
+    c.put(b"k", src)
+    src[:] = 99.0  # mutate the caller's array AFTER put
+    got = c.get(b"k")
+    np.testing.assert_array_equal(got, row(1.0))
+    with pytest.raises(ValueError):
+        got[0] = 5.0  # returned row is read-only
+
+
+def test_overwrite_same_key_keeps_size():
+    c = EmbeddingCache(capacity=4)
+    c.put(b"k", row(1))
+    c.put(b"k", row(2))
+    assert len(c) == 1
+    np.testing.assert_array_equal(c.get(b"k"), row(2))
+
+
+def test_clear_and_capacity_validation():
+    c = EmbeddingCache(capacity=4)
+    c.put(b"k", row(1))
+    c.clear()
+    assert len(c) == 0 and c.get(b"k") is None
+    with pytest.raises(ValueError):
+        EmbeddingCache(capacity=0)

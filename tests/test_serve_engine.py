@@ -1,0 +1,242 @@
+"""Engine tests: the shape-bucketed jit cache, the padding invariant the
+bucketing scheme rests on, chunking, normalization, the content cache, and
+checkpoint ingestion with architecture inference.
+
+Compile budget: one shared module-scoped engine (resnet10 @ 8x8, buckets
+(2, 8) — one replicated + one sharded program) carries most tests; the
+cached/normalized variants each add a single bucket-2 program.
+"""
+
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu.models import infer_architecture_from_variables
+from simclr_pytorch_distributed_tpu.serve.cache import EmbeddingCache
+from simclr_pytorch_distributed_tpu.serve.engine import EmbeddingEngine
+
+pytestmark = pytest.mark.serve
+
+SIZE = 8
+
+
+def images_of(rng, n):
+    return rng.integers(0, 256, size=(n, SIZE, SIZE, 3), dtype=np.uint8)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return EmbeddingEngine.random_init(
+        model_name="resnet10", size=SIZE, buckets=(2, 8)
+    )
+
+
+def test_no_recompile_within_a_bucket(engine):
+    """Request sizes 3..8 all share the bucket-8 program: exactly ONE trace
+    (the compile-count witness — the engine's reason to exist)."""
+    rng = np.random.default_rng(0)
+    for n in (3, 5, 7, 8, 4, 6):
+        out = engine.embed(images_of(rng, n))
+        assert out.shape == (n, 512) and out.dtype == np.float32
+    assert engine.stats()["traces"].get(8) == 1
+    engine.embed(images_of(rng, 1))
+    engine.embed(images_of(rng, 2))
+    assert engine.stats()["traces"].get(2) == 1
+    assert sum(engine.stats()["traces"].values()) == 2  # and nothing else
+
+
+def test_padded_bucket_equals_exact_batch(engine):
+    """Row i depends only on image i. Within one compiled bucket program the
+    equality is BITWISE: a batch of 5 padded to bucket 8 returns exactly the
+    rows those 5 images get when batched with 3 real peers instead."""
+    rng = np.random.default_rng(1)
+    x5 = images_of(rng, 5)
+    peers = images_of(rng, 3)
+    a = engine.embed(x5)  # 5 -> bucket 8, zero-padded
+    b = engine.embed(np.concatenate([x5, peers]))  # exact bucket-8 batch
+    np.testing.assert_array_equal(a, b[:5])
+
+
+def test_cross_bucket_agreement_is_float_tight(engine):
+    """Across DIFFERENT bucket programs (different shardings/layouts) XLA may
+    reorder reductions — agreement is to float tolerance, not bitwise (the
+    honest half of the padding contract; see docs/SERVING.md)."""
+    rng = np.random.default_rng(2)
+    x2 = images_of(rng, 2)
+    a = engine.embed(x2)  # bucket 2 (replicated program)
+    b = engine.embed(np.concatenate([x2, images_of(rng, 6)]))  # bucket 8 (sharded)
+    np.testing.assert_allclose(a, b[:2], rtol=1e-5, atol=1e-5)
+
+
+def test_repeat_call_bit_stable(engine):
+    rng = np.random.default_rng(3)
+    x = images_of(rng, 4)
+    np.testing.assert_array_equal(engine.embed(x), engine.embed(x))
+
+
+def test_requests_above_top_bucket_are_chunked(engine):
+    rng = np.random.default_rng(4)
+    x = images_of(rng, 13)  # 13 > top bucket 8: chunks of 8 + 5
+    before = dict(engine.stats()["bucket_dispatches"])
+    out = engine.embed(x)
+    after = engine.stats()["bucket_dispatches"]
+    assert out.shape == (13, 512)
+    assert after[8] - before[8] == 2
+    # chunk rows match embedding the pieces separately (same bucket program)
+    np.testing.assert_array_equal(out[:8], engine.embed(x[:8]))
+    np.testing.assert_array_equal(out[8:], engine.embed(x[8:]))
+    assert sum(engine.stats()["traces"].values()) == 2  # still no recompiles
+
+
+def test_empty_request_and_validation(engine):
+    assert engine.embed(np.zeros((0, SIZE, SIZE, 3), np.uint8)).shape == (0, 512)
+    with pytest.raises(ValueError, match="expected"):
+        engine.embed(np.zeros((2, SIZE, SIZE), np.uint8))
+    with pytest.raises(ValueError, match="uint8"):
+        engine.embed(np.zeros((2, SIZE, SIZE, 3), np.float32))
+
+
+def test_unpinned_geometry_is_rejected_not_compiled(engine):
+    """The bucket scheme bounds compiles only with the spatial shape pinned:
+    a novel (H, W) must be REJECTED (-> HTTP 400 through the batcher's
+    validate hook), never traced — else arbitrary client sizes recompile per
+    request (a trivial DoS on the open endpoint)."""
+    traces_before = sum(engine.stats()["traces"].values())
+    with pytest.raises(ValueError, match="pinned at construction"):
+        engine.embed(np.zeros((2, SIZE * 2, SIZE * 2, 3), np.uint8))
+    with pytest.raises(ValueError, match="pinned"):
+        engine.validate_images(np.zeros((1, SIZE, SIZE + 1, 3), np.uint8))
+    assert sum(engine.stats()["traces"].values()) == traces_before
+
+
+def test_bucket_for(engine):
+    assert [engine.bucket_for(n) for n in (1, 2, 3, 8, 9)] == [2, 2, 8, 8, 8]
+
+
+def test_bucket_sharding_policy(engine):
+    """Buckets divisible by the data axis shard across it; the rest run
+    replicated (latency path) instead of erroring on indivisibility."""
+    from jax.sharding import PartitionSpec as P
+
+    from simclr_pytorch_distributed_tpu.parallel.mesh import (
+        DATA_AXIS,
+        batch_sharding_if_divisible,
+    )
+
+    mesh = engine.mesh
+    data = mesh.shape[DATA_AXIS]  # 8 on the virtual test mesh
+    assert batch_sharding_if_divisible(mesh, data * 2, 4).spec == P(
+        DATA_AXIS, None, None, None
+    )
+    assert batch_sharding_if_divisible(mesh, 1, 4).spec == P()
+
+
+def test_normalized_output_is_unit_norm():
+    eng = EmbeddingEngine.random_init(
+        model_name="resnet10", size=SIZE, buckets=(2,), normalize=True
+    )
+    out = eng.embed(images_of(np.random.default_rng(5), 2))
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-5)
+
+
+def test_cache_hits_skip_engine_execution():
+    eng = EmbeddingEngine.random_init(
+        model_name="resnet10", size=SIZE, buckets=(2,),
+        cache=EmbeddingCache(capacity=64),
+    )
+    rng = np.random.default_rng(6)
+    x = images_of(rng, 2)
+    first = eng.embed(x)
+    dispatches = sum(eng.stats()["bucket_dispatches"].values())
+    second = eng.embed(x)  # all rows cached: the device is never touched
+    assert sum(eng.stats()["bucket_dispatches"].values()) == dispatches
+    assert eng.stats()["cache_hit_rows"] == 2
+    np.testing.assert_array_equal(first, second)
+    # partial hit: one old + one new image -> exactly one more dispatch,
+    # and the cached row is identical to the originally computed one
+    y = np.stack([x[0], images_of(rng, 1)[0]])
+    mixed = eng.embed(y)
+    assert sum(eng.stats()["bucket_dispatches"].values()) == dispatches + 1
+    np.testing.assert_array_equal(mixed[0], first[0])
+    assert eng.stats()["cache"]["hits"] == 3
+
+
+def test_shared_cache_never_crosses_engines():
+    """One EmbeddingCache behind two engines (same arch, different weights):
+    the weights fingerprint in the key must keep their rows apart — engine B
+    must never serve engine A's embeddings."""
+    shared = EmbeddingCache(capacity=64)
+    a = EmbeddingEngine.random_init(
+        model_name="resnet10", size=SIZE, seed=0, buckets=(2,), cache=shared
+    )
+    b = EmbeddingEngine.random_init(
+        model_name="resnet10", size=SIZE, seed=1, buckets=(2,), cache=shared
+    )
+    x = images_of(np.random.default_rng(7), 2)
+    out_a = a.embed(x)
+    out_b = b.embed(x)  # must MISS despite byte-identical images
+    assert shared.stats()["hits"] == 0
+    assert not np.allclose(out_a, out_b)  # different weights, different rows
+    np.testing.assert_array_equal(b.embed(x), out_b)  # b hits its OWN rows
+    assert shared.stats()["hits"] == 2
+
+
+def test_infer_architecture_from_variables():
+    import jax
+    import jax.numpy as jnp
+
+    from simclr_pytorch_distributed_tpu.models import SupConResNet
+
+    # eval_shape: architecture inference needs only the tree, never values
+    for name, head, feat_dim in (
+        ("resnet18", "mlp", 128),
+        ("resnet50", "mlp", 64),
+        ("resnet10", "linear", 128),
+    ):
+        model = SupConResNet(model_name=name, head=head, feat_dim=feat_dim)
+        v = jax.eval_shape(
+            lambda m=model: m.init(
+                jax.random.key(0), jnp.zeros((1, 8, 8, 3)), train=False
+            )
+        )
+        assert infer_architecture_from_variables(v) == (name, head, feat_dim)
+    with pytest.raises(ValueError, match="encoder"):
+        infer_architecture_from_variables({"params": {"whatever": {}}})
+
+
+def test_from_checkpoint_infers_architecture(tmp_path):
+    """An orbax model payload round-trips into a serving engine with no
+    --model flag: the architecture is read off the restored tree."""
+    import jax
+    import jax.numpy as jnp
+
+    from simclr_pytorch_distributed_tpu.models import SupConResNet
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        MODEL_LAYOUT_VERSION,
+        _save_tree,
+        _write_meta,
+    )
+
+    model = SupConResNet(model_name="resnet10")
+    v = model.init(jax.random.key(0), jnp.zeros((2, SIZE, SIZE, 3)), train=False)
+    ckpt = tmp_path / "ckpt_epoch_1"
+    _save_tree(
+        str(ckpt / "model"),
+        {"params": v["params"], "batch_stats": v["batch_stats"]},
+    )
+    _write_meta(str(ckpt), {
+        "epoch": 1, "model_layout": MODEL_LAYOUT_VERSION,
+        "config": {"dataset": "cifar100"},
+    })
+    eng = EmbeddingEngine.from_checkpoint(str(ckpt), buckets=(2,))
+    assert eng.model.model_name == "resnet10"
+    assert eng.feat_dim == 512
+    # dataset stats were taken from the checkpoint's config
+    from simclr_pytorch_distributed_tpu.ops.augment import DATASET_STATS
+
+    assert eng._aug_cfg.mean == DATASET_STATS["cifar100"][0]
+    # ...but an explicit caller override is never clobbered, even when only
+    # one of mean/std is supplied
+    eng2 = EmbeddingEngine.from_checkpoint(
+        str(ckpt), buckets=(2,), std=(1.0, 1.0, 1.0)
+    )
+    assert eng2._aug_cfg.std == (1.0, 1.0, 1.0)

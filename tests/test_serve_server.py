@@ -1,0 +1,185 @@
+"""HTTP endpoint tests (serve/server.py) — real sockets, fake engine.
+
+The handler is bound to a DynamicBatcher + a ``stats_fn`` callable, so these
+tests drive the REAL wire protocol (status codes, both JSON image encodings,
+backpressure/timeout mapping) through a per-row fake embed function — no jax
+compiles. The full engine→batcher→HTTP path runs in
+``scripts/serve_bench.py --smoke`` (tests/test_scripts.py).
+"""
+
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu.serve.batcher import DynamicBatcher
+from simclr_pytorch_distributed_tpu.serve.server import (
+    create_server,
+    start_in_thread,
+)
+
+pytestmark = pytest.mark.serve
+
+H = W = 2
+
+
+def fake_embed(images):
+    images = np.asarray(images)
+    return images.reshape(len(images), -1).sum(axis=1, keepdims=True).astype(np.float32)
+
+
+def post(base, path, obj, timeout=10):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def get(base, path, timeout=10):
+    with urllib.request.urlopen(f"{base}{path}", timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+@pytest.fixture()
+def served():
+    batcher = DynamicBatcher(fake_embed, max_batch=8, max_wait_ms=2)
+    server = create_server(batcher, lambda: {"batcher": batcher.stats()}, port=0)
+    start_in_thread(server)
+    host, port = server.server_address[:2]
+    yield f"http://{host}:{port}", batcher
+    server.shutdown()
+    server.server_close()
+    batcher.close()
+
+
+def test_healthz_and_stats(served):
+    base, _ = served
+    assert get(base, "/healthz") == (200, {"status": "ok"})
+    status, stats = get(base, "/stats")
+    assert status == 200 and "batcher" in stats
+
+
+def test_embed_nested_list_and_b64_agree(served):
+    base, _ = served
+    images = np.arange(2 * H * W * 3, dtype=np.uint8).reshape(2, H, W, 3)
+    s1, r1 = post(base, "/embed", {"images": images.tolist()})
+    s2, r2 = post(base, "/embed", {
+        "images_b64": base64.b64encode(images.tobytes()).decode(),
+        "shape": list(images.shape),
+    })
+    assert s1 == s2 == 200
+    assert r1["n"] == 2 and r1["dim"] == 1
+    np.testing.assert_array_equal(r1["embeddings"], r2["embeddings"])
+    np.testing.assert_allclose(
+        np.asarray(r1["embeddings"]), fake_embed(images)
+    )
+
+
+@pytest.mark.parametrize("body", [
+    {"images": [[1, 2], [3, 4]]},              # wrong rank
+    {"images": [[[["x"]]]]},                   # non-numeric
+    {"images_b64": "AAAA", "shape": [1, H, W]},  # bad shape length
+    {"images_b64": "AAAA", "shape": [4, H, W, 3]},  # byte count mismatch
+    {"images_b64": "not base64!!", "shape": [1, 1, 1, 3]},
+    {"wrong_key": 1},
+    {"images": [[[[0, 0, 0]]]], "timeout_ms": "100"},  # non-numeric timeout
+])
+def test_embed_bad_input_is_400(served, body):
+    base, _ = served
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        post(base, "/embed", body)
+    assert exc.value.code == 400
+    assert "error" in json.loads(exc.value.read())
+
+
+def test_unknown_path_is_404(served):
+    base, _ = served
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        get(base, "/nope")
+    assert exc.value.code == 404
+
+
+def test_queue_full_maps_to_503_with_retry_after():
+    # start=False: nothing drains, so the bounded queue actually fills
+    batcher = DynamicBatcher(fake_embed, max_batch=8, max_queue=1, start=False)
+    server = create_server(batcher, lambda: {}, port=0)
+    start_in_thread(server)
+    host, port = server.server_address[:2]
+    base = f"http://{host}:{port}"
+    try:
+        batcher.submit(np.zeros((1, H, W, 3), np.uint8))  # occupy the queue
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post(base, "/embed", {"images": np.zeros((1, H, W, 3)).tolist()})
+        assert exc.value.code == 503
+        assert exc.value.headers["Retry-After"] == "1"
+    finally:
+        server.shutdown()
+        server.server_close()
+        batcher.close(drain=False)
+
+
+def test_closed_batcher_maps_to_503_not_400():
+    """A valid request hitting a closing server is retryable (503), not the
+    client's fault (400)."""
+    batcher = DynamicBatcher(fake_embed, max_batch=8, start=False)
+    batcher.close()
+    server = create_server(batcher, lambda: {}, port=0)
+    start_in_thread(server)
+    host, port = server.server_address[:2]
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post(f"http://{host}:{port}", "/embed",
+                 {"images": np.zeros((1, H, W, 3), np.uint8).tolist()})
+        assert exc.value.code == 503
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_oversized_content_length_replies_400_and_closes_connection():
+    """Replying without reading the body must also drop the keep-alive
+    connection — otherwise the unread bytes desync the next request."""
+    import http.client
+
+    batcher = DynamicBatcher(fake_embed, max_batch=8, max_wait_ms=2)
+    server = create_server(batcher, lambda: {}, port=0)
+    start_in_thread(server)
+    host, port = server.server_address[:2]
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.putrequest("POST", "/embed")
+        conn.putheader("Content-Type", "application/json")
+        conn.putheader("Content-Length", str(10**9))  # body never sent
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 400
+        assert resp.getheader("Connection") == "close"
+        resp.read()
+        conn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+        batcher.close()
+
+
+def test_request_timeout_maps_to_504():
+    batcher = DynamicBatcher(fake_embed, max_batch=8, start=False)  # never served
+    server = create_server(batcher, lambda: {}, port=0)
+    start_in_thread(server)
+    host, port = server.server_address[:2]
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post(f"http://{host}:{port}", "/embed", {
+                "images": np.zeros((1, H, W, 3), np.uint8).tolist(),
+                "timeout_ms": 30,
+            })
+        assert exc.value.code == 504
+    finally:
+        server.shutdown()
+        server.server_close()
+        batcher.close(drain=False)
