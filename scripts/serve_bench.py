@@ -10,6 +10,15 @@ Two client models against one engine+batcher stack:
   regardless of completions (the honest service-latency model: a closed loop
   self-throttles and hides queueing, an open loop exposes it).
 
+``--sweep`` is the saturation mode: the offered open-loop rate climbs a
+geometric ladder until throughput plateaus, p99 blows up, or backpressure
+sheds most arrivals — run once against the legacy synchronous path
+(``max_inflight=1``, ``embed``) and once against the pipelined path
+(``dispatch``/completion split, ``--max_inflight`` batches in flight), so
+the committed artifact (``docs/evidence/serve_bench_sweep.json``) is a
+before/after saturated-throughput comparison with per-window latency and
+pipeline-occupancy gauges.
+
 Latencies are recorded per request and reported as p50/p95/p99 **per
 bucket** (the engine pads request sizes up to jit buckets, so e.g. size-5
 and size-7 requests share the bucket-8 program and the same latency
@@ -81,6 +90,15 @@ def per_bucket_report(records, engine):
 
 def make_images(rng, n, size):
     return rng.integers(0, 256, size=(n, size, size, 3), dtype=np.uint8)
+
+
+def emit_artifact(out, json_out):
+    print(json.dumps(out, indent=1))
+    if json_out:
+        os.makedirs(os.path.dirname(os.path.abspath(json_out)), exist_ok=True)
+        with open(json_out, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
 
 
 def closed_loop(batcher, rng, *, clients, requests_per_client, sizes, size):
@@ -196,6 +214,138 @@ def http_round_trip(engine, batcher, size):
     return out
 
 
+def make_batcher(engine, args, *, pipelined):
+    """The two comparison arms: ``pipelined=False`` is the pre-pipeline
+    synchronous path (dispatch+complete serialized per batch), ``True`` is
+    the split-stage path with ``--max_inflight`` batches on device."""
+    kwargs = dict(
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        max_queue=args.max_queue, validate=engine.validate_images,
+    )
+    if pipelined:
+        return DynamicBatcher(
+            dispatch_fn=engine.dispatch, max_inflight=args.max_inflight,
+            max_inflight_images=args.max_inflight_images, **kwargs,
+        )
+    return DynamicBatcher(engine.embed, max_inflight=1, **kwargs)
+
+
+INFLIGHT_GAUGES = (
+    "dispatched_batches", "batches", "max_inflight_observed",
+    "pipeline_occupancy", "avg_inflight_depth",
+)
+
+
+def sweep_window(engine, args, rng, rate, *, pipelined, sizes):
+    """One offered-rate window on a FRESH batcher (per-window gauges start
+    clean; the engine and its compiled programs are shared across windows)."""
+    batcher = make_batcher(engine, args, pipelined=pipelined)
+    try:
+        records, elapsed, images, shed = open_loop(
+            batcher, rng, rate_rps=rate, n_requests=args.sweep_requests,
+            sizes=sizes, size=args.img_size,
+        )
+    finally:
+        batcher.close()
+    bstats = batcher.stats()
+    return {
+        "offered_rate_rps": rate,
+        "requests_completed": len(records),
+        "shed_by_backpressure": shed,
+        "achieved_rate_rps": round(len(records) / elapsed, 2),
+        "throughput_imgs_per_s": round(images / elapsed, 2),
+        "latency": percentiles([lat for _, lat in records]),
+        "inflight": {
+            k: round(bstats[k], 4) if isinstance(bstats[k], float) else bstats[k]
+            for k in INFLIGHT_GAUGES
+        },
+    }
+
+
+def _arm_stop_reason(windows, args):
+    """Saturation test for one arm's window history (latest = windows[-1])."""
+    w = windows[-1]
+    offered = w["requests_completed"] + w["shed_by_backpressure"]
+    if offered and w["shed_by_backpressure"] / offered > 0.5:
+        return "backpressure_shed"
+    if w["latency"] and windows[0]["latency"] and (
+        w["latency"]["p99_ms"]
+        > args.sweep_p99_blowup * windows[0]["latency"]["p99_ms"]
+    ):
+        return "p99_blowup"
+    if len(windows) >= 3:
+        best_before = max(x["throughput_imgs_per_s"] for x in windows[:-1])
+        if w["throughput_imgs_per_s"] < (
+            (1.0 + args.sweep_plateau_frac) * best_before
+        ):
+            return "throughput_plateau"
+    return None
+
+
+def _arm_summary(arm, args):
+    windows = arm["windows"]
+    low = windows[0]["latency"] or {}
+    return {
+        "max_inflight": args.max_inflight if arm["pipelined"] else 1,
+        "stop_reason": arm["stop"] or "max_windows",
+        "windows": windows,
+        "saturated_imgs_per_s": max(
+            w["throughput_imgs_per_s"] for w in windows
+        ),
+        "low_load_p50_ms": low.get("p50_ms"),
+        "low_load_p99_ms": low.get("p99_ms"),
+    }
+
+
+def paired_saturation_sweep(engine, args):
+    """Climb the offered-rate ladder on BOTH arms until each saturates.
+
+    The comparison is paired twice over: rung k of both arms draws from
+    ``default_rng(seed + k)`` (identical request-size mixes and arrival
+    schedules — with sizes spanning 1..20 and a few dozen requests per
+    window, an unpaired draw moves p50 far more than the treatment does),
+    and the two arms run back-to-back WITHIN each rung, alternating which
+    goes first (ABBA), so machine-load drift across the sweep lands on
+    both arms rather than on whichever ran second. An arm that hits its
+    stop condition drops out; the ladder ends when both have."""
+    sizes = tuple(int(s) for s in args.sizes.split(","))
+    arms = {
+        "baseline": {"pipelined": False, "windows": [], "stop": None},
+        "pipelined": {"pipelined": True, "windows": [], "stop": None},
+    }
+    # one discarded warm window per arm: the first-ever open loop pays
+    # one-time costs (thread spin-up, allocator warm) that would otherwise
+    # land entirely on whichever arm runs first and skew the rung-0 pair
+    for name in ("baseline", "pipelined"):
+        warm_args = argparse.Namespace(**vars(args))
+        warm_args.sweep_requests = min(20, args.sweep_requests)
+        sweep_window(
+            engine, warm_args, np.random.default_rng(args.seed + 999_983),
+            args.sweep_start_rate, pipelined=arms[name]["pipelined"],
+            sizes=sizes,
+        )
+    rate = args.sweep_start_rate
+    for k in range(args.sweep_max_windows):
+        order = (
+            ("baseline", "pipelined") if k % 2 == 0
+            else ("pipelined", "baseline")
+        )
+        for name in order:
+            arm = arms[name]
+            if arm["stop"]:
+                continue
+            rng = np.random.default_rng(args.seed + k)
+            arm["windows"].append(sweep_window(
+                engine, args, rng, rate, pipelined=arm["pipelined"],
+                sizes=sizes,
+            ))
+            arm["stop"] = _arm_stop_reason(arm["windows"], args)
+        if all(a["stop"] for a in arms.values()):
+            break
+        rate *= args.sweep_factor
+    return {name: _arm_summary(arm, args) for name, arm in arms.items()}
+
+
 def cache_pass(batcher, engine, rng, size):
     """Submit the SAME images twice; the second pass must be answered from
     the cache (hits recorded, no new engine dispatches)."""
@@ -235,6 +385,27 @@ def main(argv=None):
     p.add_argument("--open_requests", type=int, default=200)
     p.add_argument("--sizes", default="1,3,8,20",
                    help="request sizes drawn uniformly per request")
+    p.add_argument("--max_inflight", type=int, default=3,
+                   help="pipeline window for the pipelined arm")
+    p.add_argument("--max_inflight_images", type=int, default=4096)
+    p.add_argument("--dtype", default="fp32", choices=["fp32", "bf16"],
+                   help="serving compute dtype (bf16: params+activations)")
+    p.add_argument("--sweep", action="store_true",
+                   help="saturation sweep: climb offered open-loop rate on "
+                        "the synchronous AND pipelined paths until each "
+                        "saturates; emits the before/after artifact")
+    p.add_argument("--sweep_start_rate", type=float, default=40.0)
+    p.add_argument("--sweep_factor", type=float, default=1.7,
+                   help="offered-rate multiplier per window")
+    p.add_argument("--sweep_max_windows", type=int, default=8)
+    p.add_argument("--sweep_requests", type=int, default=150,
+                   help="open-loop requests per window")
+    p.add_argument("--sweep_plateau_frac", type=float, default=0.08,
+                   help="stop when a window beats the best-so-far by less "
+                        "than this fraction")
+    p.add_argument("--sweep_p99_blowup", type=float, default=15.0,
+                   help="stop when p99 exceeds this multiple of the "
+                        "first window's p99")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json", dest="json_out", default=None)
     p.add_argument("--smoke", action="store_true",
@@ -252,36 +423,94 @@ def main(argv=None):
         args.requests_per_client = 4
         args.rate = 200.0
         args.open_requests = 12
+        args.sweep_start_rate = 150.0
+        args.sweep_factor = 2.0
+        args.sweep_max_windows = 3
+        args.sweep_requests = 24
         if args.json_out is None:
             args.json_out = os.path.join(
                 os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                "docs", "evidence", "serve_bench_smoke.json",
+                "docs", "evidence",
+                "serve_bench_sweep_smoke.json" if args.sweep
+                else "serve_bench_smoke.json",
             )
+    elif args.sweep and args.json_out is None:
+        # the full sweep IS the evidence run — always leave the artifact
+        args.json_out = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "docs", "evidence", "serve_bench_sweep.json",
+        )
 
     buckets = tuple(int(b) for b in args.buckets.split(","))
     sizes = tuple(int(s) for s in args.sizes.split(","))
-    cache = EmbeddingCache(args.cache_capacity) if args.cache_capacity else None
+    # the sweep measures the COMPUTE path: a content cache would turn repeat
+    # randomness into hits and flatter the throughput curve
+    cache = (
+        EmbeddingCache(args.cache_capacity)
+        if args.cache_capacity and not args.sweep else None
+    )
     # the bench generates --img_size images, so pin the engine to match even
     # when a checkpoint's recorded training size differs
     kwargs = dict(buckets=buckets, normalize=args.normalize, cache=cache,
-                  img_size=args.img_size)
+                  img_size=args.img_size, dtype=args.dtype)
     if args.ckpt:
         engine = EmbeddingEngine.from_checkpoint(args.ckpt, **kwargs)
     else:
         engine = EmbeddingEngine.random_init(
             model_name=args.model, size=args.img_size, seed=args.seed, **kwargs
         )
-    batcher = DynamicBatcher(
-        engine.embed, max_batch=args.max_batch,
-        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
-        validate=engine.validate_images,
-    )
     rng = np.random.default_rng(args.seed)
 
     # warm every bucket OUTSIDE the timed loops: compiles are a one-time cost
     # the steady-state latency distribution must not absorb
     for b in buckets:
         engine.embed(make_images(rng, b, args.img_size))
+
+    if args.sweep:
+        sweeps = paired_saturation_sweep(engine, args)
+        baseline, pipelined = sweeps["baseline"], sweeps["pipelined"]
+        # end-to-end proof through the PIPELINED stack: assembler -> inflight
+        # window -> completer -> HTTP
+        http_batcher = make_batcher(engine, args, pipelined=True)
+        try:
+            http_result = http_round_trip(engine, http_batcher, args.img_size)
+        finally:
+            http_batcher.close()
+        out = {
+            "metric": "serve_bench_sweep",
+            "mode": "smoke" if args.smoke else "full",
+            "model": engine.model.model_name,
+            "dtype": args.dtype,
+            "img_size": args.img_size,
+            "buckets": list(buckets),
+            "max_batch": args.max_batch,
+            "max_wait_ms": args.max_wait_ms,
+            "request_sizes": list(sizes),
+            "sweep_requests_per_window": args.sweep_requests,
+            "baseline": baseline,
+            "pipelined": pipelined,
+            "saturated_speedup": round(
+                pipelined["saturated_imgs_per_s"]
+                / max(baseline["saturated_imgs_per_s"], 1e-9), 3
+            ),
+            "low_load_p50_ratio": (
+                round(pipelined["low_load_p50_ms"] / baseline["low_load_p50_ms"], 3)
+                if pipelined["low_load_p50_ms"] and baseline["low_load_p50_ms"]
+                else None
+            ),
+            "http": http_result,
+            "engine_stats": engine.stats(),
+            "device": str(engine.mesh.devices.flat[0].device_kind),
+        }
+        return emit_artifact(out, args.json_out)
+
+    batcher = DynamicBatcher(
+        dispatch_fn=engine.dispatch, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+        max_inflight=args.max_inflight,
+        max_inflight_images=args.max_inflight_images,
+        validate=engine.validate_images,
+    )
 
     closed_records, closed_s, closed_images = closed_loop(
         batcher, rng, clients=args.clients,
@@ -300,6 +529,7 @@ def main(argv=None):
         "metric": "serve_bench",
         "mode": "smoke" if args.smoke else "full",
         "model": engine.model.model_name,
+        "dtype": args.dtype,
         "img_size": args.img_size,
         "buckets": list(buckets),
         "max_batch": args.max_batch,
@@ -326,12 +556,7 @@ def main(argv=None):
         "batcher_stats": batcher.stats(),
         "device": str(engine.mesh.devices.flat[0].device_kind),
     }
-    print(json.dumps(out, indent=1))
-    if args.json_out:
-        os.makedirs(os.path.dirname(os.path.abspath(args.json_out)), exist_ok=True)
-        with open(args.json_out, "w") as f:
-            json.dump(out, f, indent=1)
-    return out
+    return emit_artifact(out, args.json_out)
 
 
 if __name__ == "__main__":

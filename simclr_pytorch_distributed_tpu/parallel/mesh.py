@@ -143,6 +143,23 @@ def batch_sharding_if_divisible(mesh: Mesh, batch: int, ndim: int = 1) -> NamedS
     return replicated_sharding(mesh)
 
 
+def put_batch_if_divisible(mesh: Mesh, x: np.ndarray) -> jax.Array:
+    """Dispatch-stage H2D: place a host batch under the bucket layout NOW.
+
+    The serving engine's dispatch/completion split (serve/engine.py) wants
+    the host->device transfer to happen AT DISPATCH — owned by the stage
+    that runs while earlier batches are still computing — rather than
+    implicitly inside the jitted call's argument handling at whatever moment
+    the call is reached. ``device_put`` starts the transfer asynchronously
+    and returns immediately; the array lands already laid out as the bucket
+    program's ``in_shardings`` expects, so the call commits no further
+    host work and XLA never re-shards.
+    """
+    return jax.device_put(
+        x, batch_sharding_if_divisible(mesh, int(x.shape[0]), np.ndim(x))
+    )
+
+
 def tp_leaf_spec(shape, model_size: int, min_last: int = 64) -> P:
     """Channel-wise tensor-parallel spec for one state leaf.
 

@@ -4,10 +4,14 @@ Turns any checkpoint this repo produces (or imports from the reference
 ``.pth`` format) into a high-throughput embedding service:
 
 - :mod:`engine` — ``EmbeddingEngine``: checkpoint -> eval-mode encoder behind
-  a shape-bucketed jit cache (arbitrary request sizes never recompile);
+  a shape-bucketed jit cache (arbitrary request sizes never recompile), with
+  a split async API (``dispatch() -> InflightBatch``, ``result()``) and an
+  optional bf16 serving dtype;
 - :mod:`batcher` — ``DynamicBatcher``: async request queue coalescing
   concurrent submits into micro-batches under ``max_batch``/``max_wait_ms``,
-  with bounded-queue backpressure (``QueueFull``) and per-request timeouts;
+  pipelined (up to ``max_inflight`` batches on device while the assembler
+  keeps dispatching), with bounded-queue backpressure (``QueueFull``) and
+  per-request timeouts;
 - :mod:`cache` — ``EmbeddingCache``: content-keyed LRU over computed rows;
 - :mod:`server` — stdlib ``http.server`` JSON endpoint
   (``/embed``, ``/healthz``, ``/stats``) — no new runtime dependency.
@@ -22,4 +26,7 @@ from simclr_pytorch_distributed_tpu.serve.batcher import (  # noqa: F401
     RequestTimeout,
 )
 from simclr_pytorch_distributed_tpu.serve.cache import EmbeddingCache  # noqa: F401
-from simclr_pytorch_distributed_tpu.serve.engine import EmbeddingEngine  # noqa: F401
+from simclr_pytorch_distributed_tpu.serve.engine import (  # noqa: F401
+    EmbeddingEngine,
+    InflightBatch,
+)

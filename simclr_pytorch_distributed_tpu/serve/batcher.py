@@ -1,15 +1,32 @@
-"""DynamicBatcher — async request queue + micro-batching worker.
+"""DynamicBatcher — async request queue + pipelined micro-batching.
 
 Serving traffic arrives as many small concurrent requests, but the engine's
 throughput comes from large batches (the per-dispatch overhead and the
 padded-bucket waste both amortize with batch size). The batcher bridges the
 two: ``submit(images)`` returns a ``concurrent.futures.Future`` immediately,
-and a single worker thread coalesces queued requests into one engine call
+and an assembler thread coalesces queued requests into one engine call
 under two knobs:
 
 - ``max_batch`` — dispatch as soon as the coalesced batch would exceed it;
 - ``max_wait_ms`` — never hold the FIRST request of a batch longer than this
   (the latency the batcher is allowed to add hunting for batch-mates).
+
+**Pipelined execution.** With a ``dispatch_fn`` (the engine's async API,
+``EmbeddingEngine.dispatch``) the data path splits into two overlapped
+stages: the assembler keeps coalescing and DISPATCHING — host padding, H2D,
+enqueueing the compiled call — while up to ``max_inflight`` earlier batches
+are still computing on device, and a completer thread resolves each batch's
+futures as its transfer lands. Without the split, batch k's host phases and
+batch k+1's device phases serialize (the device idles during every host
+phase and vice versa — the serving analogue of the per-iter sync that cost
+the training loop 2.4x wall clock, docs/PERF.md). The window is bounded in
+BOTH batches (``max_inflight``) and total in-flight rows
+(``max_inflight_images``) so pipelining cannot hold unbounded HBM; a batch
+larger than the row bound is still admitted alone (the engine chunks it).
+Completion is strictly FIFO in dispatch order, so per-request ordering and
+the existing QueueFull/timeout/close-drain semantics are unchanged. With
+only a synchronous ``embed_fn`` the same code path runs with the compute
+folded into the dispatch stage (the pre-pipeline behavior).
 
 Backpressure is explicit: the queue is bounded BOTH in requests
 (``max_queue``) and in total queued image rows (``max_queue_images`` —
@@ -54,15 +71,42 @@ class _Request:
     deadline: Optional[float] = None  # clock() value; None = no timeout
 
 
+class _EagerHandle:
+    """Adapter giving a synchronous ``embed_fn`` the handle shape of
+    ``EmbeddingEngine.dispatch``: the compute already happened at dispatch,
+    ``result()`` just hands it back. Keeps one code path through the
+    pipeline for both engine spellings."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value):
+        self._value = value
+
+    def result(self):
+        return self._value
+
+
+@dataclass
+class _Inflight:
+    """One dispatched-but-uncompleted batch in the pipeline window."""
+
+    batch: list  # [_Request]
+    total: int  # rows (the max_inflight_images accounting unit)
+    handle: object  # .result() -> [total, dim]
+
+
 class DynamicBatcher:
     def __init__(
         self,
-        embed_fn: Callable[[np.ndarray], np.ndarray],
+        embed_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
         *,
+        dispatch_fn: Optional[Callable[[np.ndarray], object]] = None,
         max_batch: int = 128,
         max_wait_ms: float = 5.0,
         max_queue: int = 256,
         max_queue_images: int = 8192,
+        max_inflight: int = 2,
+        max_inflight_images: int = 4096,
         default_timeout_ms: Optional[float] = None,
         validate: Optional[Callable[[np.ndarray], np.ndarray]] = None,
         clock: Callable[[], float] = time.monotonic,
@@ -73,7 +117,22 @@ class DynamicBatcher:
             raise ValueError(
                 "max_batch, max_queue, and max_queue_images must be >= 1"
             )
-        self._embed_fn = embed_fn
+        if max_inflight < 1 or max_inflight_images < 1:
+            raise ValueError(
+                "max_inflight and max_inflight_images must be >= 1"
+            )
+        if embed_fn is None and dispatch_fn is None:
+            raise ValueError("need embed_fn or dispatch_fn")
+        if embed_fn is not None and dispatch_fn is not None:
+            # silently preferring one would serve requests through a
+            # different function than the caller supplied
+            raise ValueError("pass embed_fn OR dispatch_fn, not both")
+        if dispatch_fn is None:
+            # synchronous engine: compute runs inside the dispatch stage and
+            # completion is a no-op — the pre-pipeline behavior, and what
+            # the policy unit tests' fake embed functions exercise
+            dispatch_fn = lambda images: _EagerHandle(embed_fn(images))  # noqa: E731
+        self._dispatch_fn = dispatch_fn
         self._max_batch = int(max_batch)
         self._max_wait_s = float(max_wait_ms) / 1e3
         self._max_queue = int(max_queue)
@@ -95,22 +154,44 @@ class DynamicBatcher:
         self._cond = threading.Condition()
         self._pending: "deque[_Request]" = deque()
         self._closed = False
+        # pipeline window: batches dispatched to the device but not yet
+        # materialized. Only the assembler appends, only the completer pops
+        # — so the completer may peek [0] unlocked-result() safely.
+        self._max_inflight = int(max_inflight)
+        self._max_inflight_images = int(max_inflight_images)
+        self._inflight: "deque[_Inflight]" = deque()
+        self._inflight_rows = 0
+        self._assembler_done = False
+        # time-weighted pipeline occupancy (∫depth·dt), read via ``clock`` so
+        # the gauges are as fake-clock-testable as the deadlines
+        self._occ_start = self._clock()
+        self._occ_last = self._occ_start
+        self._occ_area = 0.0  # ∫ inflight_depth dt
+        self._occ_busy = 0.0  # time with >= 1 batch in flight
         self._stats = {
             "submitted": 0,
             "rejected": 0,
             "timeouts": 0,
             "batches": 0,
             "batched_images": 0,
+            "dispatched_batches": 0,
             "errors": 0,
             "max_queue_depth": 0,
             "max_batch_observed": 0,
+            "max_inflight_observed": 0,
         }
         self._thread: Optional[threading.Thread] = None
+        self._completer: Optional[threading.Thread] = None
         if start:
             self._thread = threading.Thread(
-                target=self._worker, name="dynamic-batcher", daemon=True
+                target=self._worker, name="batcher-assembler", daemon=True
+            )
+            self._completer = threading.Thread(
+                target=self._completer_loop, name="batcher-completer",
+                daemon=True,
             )
             self._thread.start()
+            self._completer.start()
 
     # ------------------------------------------------------------- client
 
@@ -164,11 +245,14 @@ class DynamicBatcher:
         return req.future
 
     def close(self, drain: bool = True) -> None:
-        """Stop accepting submits; by default the worker finishes everything
-        already queued before exiting (``drain=False`` fails queued requests
-        immediately). With no worker thread (``start=False``) there is
-        nobody to drain — queued requests are failed either way rather than
-        leaving their futures hanging forever."""
+        """Stop accepting submits; by default the pipeline finishes
+        everything already queued before exiting (``drain=False`` fails
+        QUEUED requests immediately — batches already dispatched to the
+        device are completed either way: their compute is spent and their
+        waiters are blocked on real futures). With no worker thread
+        (``start=False``) there is nobody to drain — queued requests are
+        failed either way rather than leaving their futures hanging
+        forever."""
         with self._cond:
             self._closed = True
             if not drain or self._thread is None:
@@ -180,16 +264,31 @@ class DynamicBatcher:
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        if self._completer is not None:
+            self._completer.join()
+            self._completer = None
 
     def stats(self) -> dict:
         with self._cond:
             s = dict(self._stats)
             s["queue_depth"] = len(self._pending)
             s["queued_images"] = self._pending_images
+            s["inflight_batches"] = len(self._inflight)
+            s["inflight_rows"] = self._inflight_rows
+            self._occ_tick_locked()  # bring the integrals up to now
+            elapsed = self._occ_last - self._occ_start
+            s["pipeline_occupancy"] = (
+                self._occ_busy / elapsed if elapsed > 0 else 0.0
+            )
+            s["avg_inflight_depth"] = (
+                self._occ_area / elapsed if elapsed > 0 else 0.0
+            )
         s["max_batch"] = self._max_batch
         s["max_wait_ms"] = self._max_wait_s * 1e3
         s["max_queue"] = self._max_queue
         s["max_queue_images"] = self._max_queue_images
+        s["max_inflight"] = self._max_inflight
+        s["max_inflight_images"] = self._max_inflight_images
         if s["batches"]:
             s["avg_batch_images"] = s["batched_images"] / s["batches"]
         return s
@@ -265,28 +364,66 @@ class DynamicBatcher:
                 self._cond.wait(self._poll)
         return batch
 
-    def _dispatch(self, batch) -> None:
+    # ---------------------------------------------- dispatch & completion
+
+    def _occ_tick_locked(self) -> None:
+        """Advance the occupancy integrals to now at the CURRENT depth.
+
+        Must be called (under the lock) immediately before any change to
+        ``len(self._inflight)`` so ∫depth·dt charges each interval to the
+        depth that actually held during it.
+        """
+        now = self._clock()
+        dt = now - self._occ_last
+        if dt > 0:
+            depth = len(self._inflight)
+            self._occ_area += depth * dt
+            if depth:
+                self._occ_busy += dt
+            self._occ_last = now
+
+    def _start_dispatch(self, batch) -> Optional[_Inflight]:
+        """Dispatch stage: concatenate and hand the batch to the engine.
+
+        With the engine's async API this runs only the host phases (padding,
+        H2D, enqueueing the compiled call); the device is computing when it
+        returns. A dispatch-time failure fails every waiter here — there is
+        nothing in flight to complete."""
         total = sum(r.n for r in batch)
         images = (
             batch[0].images if len(batch) == 1
             else np.concatenate([r.images for r in batch], axis=0)
         )
         try:
-            emb = self._embed_fn(images)
+            handle = self._dispatch_fn(images)
         except Exception as exc:  # noqa: BLE001 — delivered to every waiter
             with self._cond:
                 self._stats["errors"] += 1
             for req in batch:
                 self._fail(req, exc)
+            return None
+        with self._cond:
+            self._stats["dispatched_batches"] += 1
+        return _Inflight(batch=batch, total=total, handle=handle)
+
+    def _finish(self, inflight: _Inflight) -> None:
+        """Completion stage: block on the result and resolve the futures."""
+        try:
+            emb = inflight.handle.result()
+        except Exception as exc:  # noqa: BLE001 — delivered to every waiter
+            with self._cond:
+                self._stats["errors"] += 1
+            for req in inflight.batch:
+                self._fail(req, exc)
             return
         with self._cond:
             self._stats["batches"] += 1
-            self._stats["batched_images"] += total
+            self._stats["batched_images"] += inflight.total
             self._stats["max_batch_observed"] = max(
-                self._stats["max_batch_observed"], total
+                self._stats["max_batch_observed"], inflight.total
             )
         offset = 0
-        for req in batch:
+        for req in inflight.batch:
             rows = emb[offset:offset + req.n]
             offset += req.n
             try:
@@ -294,9 +431,65 @@ class DynamicBatcher:
             except InvalidStateError:
                 pass  # cancelled mid-flight
 
+    def _dispatch(self, batch) -> None:
+        """Synchronous dispatch+complete — the no-worker (``start=False``)
+        path the policy unit tests drive batch by batch."""
+        inflight = self._start_dispatch(batch)
+        if inflight is not None:
+            self._finish(inflight)
+
     def _worker(self) -> None:
+        """Assembler: coalesce -> wait for window room -> dispatch.
+
+        Window admission happens BEFORE the dispatch call: the window
+        bounds HBM, and the dispatch stage is what allocates device buffers
+        (H2D + the enqueued program's outputs). Room only grows between the
+        check and the dispatch — the completer is the sole remover and this
+        thread the sole adder — so the post-dispatch append needs no
+        re-check. The row bound admits an oversized batch alone
+        (``self._inflight`` empty) rather than deadlocking on it.
+        """
         while True:
             batch = self._next_batch()
             if batch is None:
-                return
-            self._dispatch(batch)
+                break
+            total = sum(r.n for r in batch)
+            with self._cond:
+                while len(self._inflight) >= self._max_inflight or (
+                    self._inflight
+                    and self._inflight_rows + total > self._max_inflight_images
+                ):
+                    self._cond.wait(self._poll)
+            inflight = self._start_dispatch(batch)
+            if inflight is None:
+                continue
+            with self._cond:
+                self._occ_tick_locked()
+                self._inflight.append(inflight)
+                self._inflight_rows += inflight.total
+                self._stats["max_inflight_observed"] = max(
+                    self._stats["max_inflight_observed"], len(self._inflight)
+                )
+                self._cond.notify_all()
+        with self._cond:
+            self._assembler_done = True
+            self._cond.notify_all()
+
+    def _completer_loop(self) -> None:
+        """Completer: resolve in-flight batches strictly FIFO in dispatch
+        order (per-request ordering is preserved end to end)."""
+        while True:
+            with self._cond:
+                while not self._inflight and not self._assembler_done:
+                    self._cond.wait(self._poll)
+                if not self._inflight:
+                    return  # assembler exited and the window is drained
+                inflight = self._inflight[0]  # peek: stays visible in gauges
+            # blocking D2H happens OUTSIDE the lock — submits, stats polls,
+            # and the assembler's window wait all proceed meanwhile
+            self._finish(inflight)
+            with self._cond:
+                self._occ_tick_locked()
+                self._inflight.popleft()
+                self._inflight_rows -= inflight.total
+                self._cond.notify_all()

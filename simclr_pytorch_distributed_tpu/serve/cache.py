@@ -47,11 +47,27 @@ class EmbeddingCache:
             return row
 
     def put(self, key: bytes, row: np.ndarray) -> None:
-        frozen = np.array(row, copy=True)
-        frozen.setflags(write=False)
+        self.put_many([(key, row)])
+
+    def put_many(self, items) -> None:
+        """Insert ``[(key, row), ...]`` under ONE lock acquisition.
+
+        The pipelined completion stage lands a whole engine batch at once
+        (serve/engine.py ``InflightBatch.result``); taking the lock per row
+        would interleave lock traffic with the HTTP stats readers for every
+        row of every batch. :meth:`put` is the single-row spelling.
+        """
+        frozen_items = []
+        for key, row in items:
+            frozen = np.array(row, copy=True)
+            frozen.setflags(write=False)
+            frozen_items.append((key, frozen))
+        if not frozen_items:
+            return
         with self._lock:
-            self._data[key] = frozen
-            self._data.move_to_end(key)
+            for key, frozen in frozen_items:
+                self._data[key] = frozen
+                self._data.move_to_end(key)
             while len(self._data) > self.capacity:
                 self._data.popitem(last=False)
                 self._evictions += 1

@@ -28,6 +28,30 @@ buckets at the same latency); smaller buckets run replicated.
 The optional ``cache`` (serve/cache.py) sits in FRONT of the compiled call:
 rows whose content hash hits skip engine execution entirely, and a request
 made entirely of hits never touches the device.
+
+**Dispatch/completion split.** jax dispatches jitted calls asynchronously:
+the call returns a device array the moment the work is ENQUEUED, and only
+``np.asarray`` (D2H) blocks on it. The training loop already exploits this
+(docs/PERF.md: a per-step sync cost 2.4x wall clock); serving gets the same
+split here. ``dispatch(images) -> InflightBatch`` runs the host stages —
+validation, cache probe, bucket padding, H2D via
+``parallel.mesh.put_batch_if_divisible`` — and enqueues the compiled call
+for EVERY bucket chunk without materializing anything;
+``InflightBatch.result()`` is the completion stage: it blocks on D2H,
+slices pad rows, and populates the cache. ``embed`` is now literally
+``dispatch(...).result()``, so a miss set spanning several bucket chunks
+overlaps chunk k+1's dispatch with chunk k's compute instead of
+round-tripping each chunk, and the DynamicBatcher keeps several whole
+batches in flight by holding their ``InflightBatch`` handles
+(serve/batcher.py).
+
+**bf16 serving** (``dtype="bf16"``): params and activations are cast to
+bfloat16 at load — the same bf16-on-MXU win the trainers take with
+``--bf16`` — while BN statistics stay fp32 (models/norm.py normalizes in
+fp32 regardless of compute dtype) and the head output is cast back to fp32,
+so the wire contract is unchanged. Parity with fp32 serving is pinned by
+``tests/test_serve_engine.py`` the same way ``tests/test_eval_determinism.py``
+pins the fp32 contract.
 """
 
 from __future__ import annotations
@@ -54,10 +78,59 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
     DATA_AXIS,
     batch_sharding_if_divisible,
     create_mesh,
+    put_batch_if_divisible,
     replicated_sharding,
 )
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)
+SERVE_DTYPES = ("fp32", "bf16")
+
+
+class InflightBatch:
+    """Handle to dispatched-but-unmaterialized engine work.
+
+    Created by :meth:`EmbeddingEngine.dispatch` after every bucket chunk's
+    compiled call has been ENQUEUED on the device; ``result()`` is the
+    completion stage — it blocks on the D2H transfers, slices the pad rows
+    off, writes computed rows into the content cache, and returns the
+    float32 ``[n, dim]`` array. Idempotent: repeat calls return the same
+    array without touching the device again. The handle owns device buffers
+    until completed, which is exactly what the batcher's in-flight row
+    bound counts (serve/batcher.py ``max_inflight_images``).
+    """
+
+    def __init__(self, engine, out, n, chunks, keys):
+        self._engine = engine
+        self._out = out
+        self._n = n
+        self._chunks = chunks  # [(miss row indices, device array)]
+        self._keys = keys
+        self._done = False
+        self._lock = threading.Lock()
+
+    @property
+    def n_rows(self) -> int:
+        """Total request rows (the batcher's HBM-bound accounting unit)."""
+        return self._n
+
+    def done(self) -> bool:
+        with self._lock:
+            return self._done
+
+    def result(self) -> np.ndarray:
+        with self._lock:
+            if not self._done:
+                cache = self._engine.cache
+                for rows, dev in self._chunks:
+                    emb = np.asarray(dev)[: len(rows)]  # blocks on D2H
+                    self._out[rows] = emb
+                    if self._keys is not None:
+                        cache.put_many(
+                            [(self._keys[i], emb[j]) for j, i in enumerate(rows)]
+                        )
+                self._chunks = ()  # release device buffers
+                self._done = True
+            return self._out
 
 
 class EmbeddingEngine:
@@ -85,9 +158,25 @@ class EmbeddingEngine:
         std: Optional[Tuple[float, ...]] = None,
         img_size: int = 32,
         cache=None,
+        dtype: str = "fp32",
     ):
         if output not in ("features", "projection"):
             raise ValueError(f"output must be features|projection, got {output!r}")
+        if dtype not in SERVE_DTYPES:
+            raise ValueError(f"dtype must be one of {SERVE_DTYPES}, got {dtype!r}")
+        self.dtype = dtype
+        if dtype == "bf16":
+            # params + activations cast to bf16 at load (halved param HBM,
+            # MXU-native compute — the trainers' --bf16 win); BN statistics
+            # stay fp32 (models/norm.py normalizes in fp32 regardless of
+            # compute dtype) and _apply casts the head output back to fp32
+            model = model.clone(dtype=jnp.bfloat16)
+            variables = dict(variables)
+            variables["params"] = jax.tree.map(
+                lambda x: jnp.asarray(x, jnp.bfloat16)
+                if jnp.issubdtype(x.dtype, np.floating) else x,
+                variables["params"],
+            )
         buckets = tuple(sorted(int(b) for b in buckets))
         if not buckets or buckets[0] < 1:
             raise ValueError(f"buckets must be positive, got {buckets}")
@@ -140,7 +229,7 @@ class EmbeddingEngine:
         weights_probe = probe.hexdigest()[:16]
         self._key_prefix = (
             f"{model.model_name}|{weights_probe}|{self.output}|"
-            f"{int(self.normalize)}|{self._aug_cfg.mean}|"
+            f"{int(self.normalize)}|{self.dtype}|{self._aug_cfg.mean}|"
             f"{self._aug_cfg.std}|".encode()
         )
 
@@ -238,7 +327,11 @@ class EmbeddingEngine:
                 self._jit_fns[sharded] = fn
         return fn
 
-    def _run_bucket(self, images_u8: np.ndarray) -> np.ndarray:
+    def _dispatch_chunk(self, images_u8: np.ndarray) -> jax.Array:
+        """Pad to the bucket, start the H2D transfer, enqueue the compiled
+        call — and return the UNmaterialized device array. Everything here
+        is the dispatch stage; the only blocking step (D2H) belongs to
+        ``InflightBatch.result``."""
         n = images_u8.shape[0]
         bucket = self.bucket_for(n)
         padded = images_u8
@@ -248,8 +341,8 @@ class EmbeddingEngine:
         with self._lock:
             self._stats["bucket_dispatches"][bucket] += 1
             self._stats["padded_rows"] += bucket - n
-        out = self._fn_for(bucket)(self._variables, jnp.asarray(padded))
-        return np.asarray(out)[:n]
+        x = put_batch_if_divisible(self.mesh, padded)
+        return self._fn_for(bucket)(self._variables, x)
 
     def _cache_key(self, image_u8: np.ndarray) -> bytes:
         h = hashlib.sha1(self._key_prefix)
@@ -280,22 +373,26 @@ class EmbeddingEngine:
             )
         return images
 
-    def embed(self, images: np.ndarray) -> np.ndarray:
-        """uint8 ``[n, H, W, 3]`` -> float32 ``[n, feat_dim]``.
+    def dispatch(self, images: np.ndarray) -> InflightBatch:
+        """Start one request's device work without waiting for it.
 
-        Row i's embedding depends only on image i — never on which request
-        peers or pad rows it was batched with — so micro-batching and the
-        content cache are transparent to callers.
+        Runs every host-side stage — validation, stats, cache probe, bucket
+        padding, H2D — and enqueues the compiled call for ALL bucket chunks
+        of the miss set (a multi-bucket request overlaps chunk k+1's
+        dispatch with chunk k's compute instead of round-tripping each).
+        The returned :class:`InflightBatch` completes with ``result()``;
+        until then the device computes while the caller assembles the next
+        batch (serve/batcher.py keeps ``max_inflight`` of these on device).
         """
         images = self.validate_images(images)
         n = images.shape[0]
+        out = np.empty((n, self.feat_dim), np.float32)
         if n == 0:
-            return np.zeros((0, self.feat_dim), np.float32)
+            return InflightBatch(self, out, 0, [], None)
         with self._lock:
             self._stats["requests"] += 1
             self._stats["images"] += n
 
-        out = np.empty((n, self.feat_dim), np.float32)
         if self.cache is None:
             miss_rows = list(range(n))
             keys = None
@@ -313,15 +410,22 @@ class EmbeddingEngine:
                 with self._lock:
                     self._stats["cache_hit_rows"] += hit_rows
 
+        chunks = []
         max_bucket = self.buckets[-1]
         for lo in range(0, len(miss_rows), max_bucket):
             rows = miss_rows[lo:lo + max_bucket]
-            emb = self._run_bucket(images[rows])
-            for j, i in enumerate(rows):
-                out[i] = emb[j]
-                if keys is not None:
-                    self.cache.put(keys[i], emb[j])
-        return out
+            chunks.append((rows, self._dispatch_chunk(images[rows])))
+        return InflightBatch(self, out, n, chunks, keys)
+
+    def embed(self, images: np.ndarray) -> np.ndarray:
+        """uint8 ``[n, H, W, 3]`` -> float32 ``[n, feat_dim]``.
+
+        Row i's embedding depends only on image i — never on which request
+        peers or pad rows it was batched with — so micro-batching and the
+        content cache are transparent to callers. Synchronous spelling of
+        ``dispatch(...).result()``.
+        """
+        return self.dispatch(images).result()
 
     # -------------------------------------------------------------- stats
 
@@ -336,6 +440,7 @@ class EmbeddingEngine:
         s["model"] = self.model.model_name
         s["output"] = self.output
         s["normalize"] = self.normalize
+        s["dtype"] = self.dtype
         s["buckets"] = list(self.buckets)
         s["feat_dim"] = self.feat_dim
         if self.cache is not None:

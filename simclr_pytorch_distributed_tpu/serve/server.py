@@ -191,13 +191,12 @@ def combined_stats_fn(engine, batcher: DynamicBatcher):
     return stats
 
 
-def main(argv=None):
+def build_parser():
     import argparse
 
-    from simclr_pytorch_distributed_tpu.serve.cache import EmbeddingCache
     from simclr_pytorch_distributed_tpu.serve.engine import (
         DEFAULT_BUCKETS,
-        EmbeddingEngine,
+        SERVE_DTYPES,
     )
 
     p = argparse.ArgumentParser(
@@ -216,6 +215,17 @@ def main(argv=None):
     p.add_argument("--max_batch", type=int, default=128)
     p.add_argument("--max_wait_ms", type=float, default=5.0)
     p.add_argument("--max_queue", type=int, default=256)
+    p.add_argument("--max_inflight", type=int, default=2,
+                   help="pipeline window: batches dispatched to the device "
+                        "but not yet materialized (1 = the unpipelined "
+                        "serial path)")
+    p.add_argument("--max_inflight_images", type=int, default=4096,
+                   help="row bound on the pipeline window (caps in-flight "
+                        "HBM; batch count alone would not)")
+    p.add_argument("--dtype", default="fp32", choices=list(SERVE_DTYPES),
+                   help="serving compute dtype: bf16 casts params + "
+                        "activations at load (BN stats stay fp32, head "
+                        "output is returned fp32)")
     p.add_argument("--img_size", type=int, default=None,
                    help="pinned request H=W (default: the checkpoint "
                         "config's --size, else 32); mismatched requests "
@@ -226,12 +236,23 @@ def main(argv=None):
                    choices=["features", "projection"])
     p.add_argument("--cache_capacity", type=int, default=4096,
                    help="content-keyed LRU rows; 0 disables the cache")
-    args = p.parse_args(argv)
+    return p
+
+
+def build_stack(args):
+    """Engine + pipelined batcher + HTTP server from parsed args.
+
+    Split from :func:`main` so tests (and embedders) can build the exact
+    stack the CLI serves — including ``--dtype bf16`` and the pipeline
+    knobs — without entering ``serve_forever``.
+    """
+    from simclr_pytorch_distributed_tpu.serve.cache import EmbeddingCache
+    from simclr_pytorch_distributed_tpu.serve.engine import EmbeddingEngine
 
     buckets = tuple(int(b) for b in args.buckets.split(","))
     cache = EmbeddingCache(args.cache_capacity) if args.cache_capacity else None
     kwargs = dict(buckets=buckets, normalize=args.normalize,
-                  output=args.output, cache=cache)
+                  output=args.output, cache=cache, dtype=args.dtype)
     if args.img_size is not None:
         kwargs["img_size"] = args.img_size
     if args.ckpt:
@@ -242,15 +263,26 @@ def main(argv=None):
             model_name=args.model, size=kwargs.get("img_size", 32), **kwargs
         )
     batcher = DynamicBatcher(
-        engine.embed, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        # async dispatch: the assembler pipelines batches onto the device
+        # while the completer materializes earlier ones
+        dispatch_fn=engine.dispatch,
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         max_queue=args.max_queue,
+        max_inflight=args.max_inflight,
+        max_inflight_images=args.max_inflight_images,
         # geometry mismatches fail the submit (-> 400), never a worker batch
         validate=engine.validate_images,
     )
     server = create_server(batcher, combined_stats_fn(engine, batcher),
                            host=args.host, port=args.port)
-    logging.info("serving %s embeddings on http://%s:%d",
-                 engine.model.model_name, args.host, args.port)
+    return engine, batcher, server
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    engine, batcher, server = build_stack(args)
+    logging.info("serving %s embeddings (%s) on http://%s:%d",
+                 engine.model.model_name, engine.dtype, args.host, args.port)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -304,6 +304,51 @@ def test_serve_bench_smoke_end_to_end(tmp_path):
     assert artifact["batcher_stats"]["errors"] == 0
 
 
+@pytest.mark.serve
+def test_serve_bench_sweep_smoke_end_to_end(tmp_path):
+    """The saturation-sweep acceptance run on CPU: both comparison arms
+    (synchronous baseline, pipelined) climb the offered-rate ladder through
+    the REAL assembler -> inflight window -> completer stack, per-window
+    inflight gauges land in the artifact, and the pipelined arm PROVABLY
+    held >1 batch in flight while the baseline never did."""
+    serve_bench = _load("serve_bench")
+    out_path = tmp_path / "serve_bench_sweep_smoke.json"
+    out = serve_bench.main(["--smoke", "--sweep", "--json", str(out_path)])
+
+    with open(out_path) as f:
+        artifact = json.load(f)
+    assert artifact == json.loads(json.dumps(out))
+    assert artifact["metric"] == "serve_bench_sweep"
+    assert artifact["mode"] == "smoke"
+    for arm, inflight in (("baseline", 1), ("pipelined", 3)):
+        a = artifact[arm]
+        assert a["max_inflight"] == inflight
+        assert len(a["windows"]) >= 1
+        assert a["saturated_imgs_per_s"] > 0
+        for w in a["windows"]:
+            assert w["requests_completed"] > 0
+            assert w["latency"]["p50_ms"] <= w["latency"]["p99_ms"]
+            assert 0.0 <= w["inflight"]["pipeline_occupancy"] <= 1.0
+            assert (
+                w["inflight"]["dispatched_batches"]
+                >= w["inflight"]["batches"]
+            )
+    # the pipelined arm really pipelined; the baseline arm never could
+    assert max(
+        w["inflight"]["max_inflight_observed"]
+        for w in artifact["pipelined"]["windows"]
+    ) > 1
+    assert all(
+        w["inflight"]["max_inflight_observed"] <= 1
+        for w in artifact["baseline"]["windows"]
+    )
+    # one compile per bucket ACROSS both arms and the HTTP round trip —
+    # the ladder never re-traced
+    assert artifact["engine_stats"]["traces"] == {"2": 1, "8": 1}
+    assert artifact["http"]["healthz"] == "ok"
+    assert artifact["saturated_speedup"] > 0
+
+
 # -------------------------------------------------------------- xplane_bw
 
 

@@ -273,6 +273,21 @@ def test_concurrent_submitters_all_get_their_rows():
         np.testing.assert_array_equal(results[i], fake_embed(imgs(i, i)))
 
 
+def test_pipeline_stats_present_in_no_worker_path():
+    """The synchronous (start=False) path reports the pipeline gauges too —
+    depth stays 0 but dispatched/completed counters move together."""
+    b = DynamicBatcher(fake_embed, max_batch=8, start=False)
+    s = b.stats()
+    assert s["inflight_batches"] == 0 and s["inflight_rows"] == 0
+    assert s["dispatched_batches"] == 0 and s["max_inflight_observed"] == 0
+    b.submit(imgs(1))
+    b._dispatch(b._next_batch())
+    s = b.stats()
+    assert s["dispatched_batches"] == 1 and s["batches"] == 1
+    assert s["max_inflight"] == 2  # config echo (the default window)
+    b.close()
+
+
 def test_submit_validation():
     b = DynamicBatcher(fake_embed, start=False)
     with pytest.raises(ValueError):

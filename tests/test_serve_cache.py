@@ -56,6 +56,23 @@ def test_overwrite_same_key_keeps_size():
     np.testing.assert_array_equal(c.get(b"k"), row(2))
 
 
+def test_put_many_matches_put_semantics():
+    """The completion stage's batched insert: one lock, same freeze +
+    eviction behavior as row-by-row put."""
+    c = EmbeddingCache(capacity=3)
+    src = row(1.0)
+    c.put_many([(b"a", src), (b"b", row(2)), (b"c", row(3)), (b"d", row(4))])
+    src[:] = 99.0  # stored copies are frozen against caller mutation
+    assert len(c) == 3
+    assert c.get(b"a") is None  # oldest of the batch evicted
+    assert c.stats()["evictions"] == 1
+    np.testing.assert_array_equal(c.get(b"b"), row(2))
+    with pytest.raises(ValueError):
+        c.get(b"d")[0] = 5.0  # read-only, like put's rows
+    c.put_many([])  # no-op, no lock churn
+    assert len(c) == 3
+
+
 def test_clear_and_capacity_validation():
     c = EmbeddingCache(capacity=4)
     c.put(b"k", row(1))

@@ -112,6 +112,75 @@ def test_bucket_for(engine):
     assert [engine.bucket_for(n) for n in (1, 2, 3, 8, 9)] == [2, 2, 8, 8, 8]
 
 
+def test_dispatch_defers_materialization_and_dispatches_all_chunks(engine):
+    """The async API's contract, pinned by the dispatch counters: a miss set
+    spanning bucket chunks enqueues EVERY chunk's compiled call before
+    anything materializes (the old ``embed`` round-tripped chunk k's D2H
+    before dispatching chunk k+1 — the serialization this PR removes)."""
+    rng = np.random.default_rng(8)
+    x = images_of(rng, 13)  # chunks of 8 + 5 through the top bucket
+    before = dict(engine.stats()["bucket_dispatches"])
+    h = engine.dispatch(x)
+    mid = engine.stats()["bucket_dispatches"]
+    assert mid[8] - before[8] == 2  # both chunks already dispatched...
+    assert not h.done()             # ...and nothing materialized yet
+    assert h.n_rows == 13
+    out = h.result()
+    assert h.done() and out.shape == (13, 512)
+    # completion == the synchronous spelling (same bucket programs: bitwise)
+    np.testing.assert_array_equal(out, engine.embed(x))
+    assert h.result() is out  # idempotent; device buffers already released
+
+
+def test_dispatch_populates_cache_at_completion():
+    eng = EmbeddingEngine.random_init(
+        model_name="resnet10", size=SIZE, buckets=(2,),
+        cache=EmbeddingCache(capacity=64),
+    )
+    x = images_of(np.random.default_rng(9), 2)
+    h = eng.dispatch(x)
+    assert len(eng.cache) == 0  # rows land in the cache at COMPLETION
+    first = h.result()
+    assert len(eng.cache) == 2
+    dispatches = sum(eng.stats()["bucket_dispatches"].values())
+    second = eng.dispatch(x).result()  # full hit: the device is not touched
+    assert sum(eng.stats()["bucket_dispatches"].values()) == dispatches
+    np.testing.assert_array_equal(first, second)
+
+
+def test_bf16_serving_parity_and_contract(engine):
+    """--dtype bf16: params cast to bf16, BN statistics kept fp32, head
+    output returned fp32 — and embeddings within a pinned tolerance of the
+    fp32 engine (observed ~7e-3 max abs on CPU; 5x margin)."""
+    import jax
+    import jax.numpy as jnp
+
+    b16 = EmbeddingEngine.random_init(
+        model_name="resnet10", size=SIZE, buckets=(8,), dtype="bf16"
+    )  # seed 0 = the shared fp32 fixture's weights, cast
+    x = images_of(np.random.default_rng(10), 8)
+    a = engine.embed(x)  # fp32 reference (bucket-8 program)
+    b = b16.embed(x)
+    assert b.dtype == np.float32
+    np.testing.assert_allclose(b, a, rtol=0.05, atol=0.05)
+    cos = (a * b).sum(1) / (
+        np.linalg.norm(a, axis=1) * np.linalg.norm(b, axis=1)
+    )
+    assert cos.min() > 0.995
+    assert b16.stats()["dtype"] == "bf16"
+    for leaf in jax.tree.leaves(b16._variables["params"]):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.bfloat16
+    for leaf in jax.tree.leaves(b16._variables["batch_stats"]):
+        assert leaf.dtype == jnp.float32  # models/norm.py fp32-stats contract
+    # byte-identical images served under different dtypes never share a
+    # cache row
+    assert b16._key_prefix != engine._key_prefix
+    with pytest.raises(ValueError, match="dtype"):
+        EmbeddingEngine.random_init(model_name="resnet10", size=SIZE,
+                                    dtype="fp16")
+
+
 def test_bucket_sharding_policy(engine):
     """Buckets divisible by the data axis shard across it; the rest run
     replicated (latency path) instead of erroring on indivisibility."""

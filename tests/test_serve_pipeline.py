@@ -1,0 +1,376 @@
+"""Pipelined-executor tests: the dispatch/completion split in
+serve/batcher.py + serve/engine.py.
+
+The pipelining PROOF tests drive a gated fake dispatch function whose
+``result()`` blocks on an Event the test controls — so "batch k+1 was
+dispatched while batch k was still in flight" is asserted directly, not
+inferred from timing. The stress tests then run the REAL engine behind the
+pipelined batcher and pin the concurrent results to sequential
+``engine.embed`` under the cross-bucket allclose contract
+(tests/test_serve_engine.py).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu.serve.batcher import DynamicBatcher
+
+pytestmark = pytest.mark.serve
+
+H = W = 2
+
+
+def imgs(*values):
+    out = np.zeros((len(values), H, W, 3), np.uint8)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
+
+
+def fake_rows(images):
+    images = np.asarray(images)
+    return images.reshape(len(images), -1).sum(
+        axis=1, keepdims=True
+    ).astype(np.float32)
+
+
+class GatedDispatch:
+    """Fake async engine: dispatch returns instantly, each handle's
+    ``result()`` blocks until the test releases that handle's gate."""
+
+    def __init__(self):
+        self.handles = []
+        self.lock = threading.Lock()
+        self.auto = False  # release_all is sticky: later handles born open
+
+    def __call__(self, images):
+        h = _GatedHandle(np.asarray(images))
+        with self.lock:
+            if self.auto:
+                h.gate.set()
+            self.handles.append(h)
+        return h
+
+    def release_all(self):
+        with self.lock:
+            self.auto = True
+            for h in self.handles:
+                h.gate.set()
+
+    def count(self):
+        with self.lock:
+            return len(self.handles)
+
+
+class _GatedHandle:
+    def __init__(self, images):
+        self.images = images
+        self.gate = threading.Event()
+
+    def result(self):
+        assert self.gate.wait(10), "test forgot to release a gate"
+        return fake_rows(self.images)
+
+
+def wait_until(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.002)
+    return False
+
+
+def make(dispatch, **kw):
+    kw.setdefault("max_batch", 1)  # one request = one batch: window counts requests
+    kw.setdefault("max_wait_ms", 0)
+    return DynamicBatcher(dispatch_fn=dispatch, **kw)
+
+
+# ------------------------------------------------------- pipelining proof
+
+
+def test_next_batch_dispatched_before_previous_materializes():
+    """THE acceptance property: with max_inflight > 1, batch k+1's dispatch
+    happens while batch k is still unmaterialized (its gate is closed)."""
+    d = GatedDispatch()
+    b = make(d, max_inflight=2)
+    try:
+        f1 = b.submit(imgs(1))
+        f2 = b.submit(imgs(2))
+        # both dispatched, NEITHER completed — the device-side window holds 2
+        assert wait_until(lambda: d.count() == 2)
+        assert not f1.done() and not f2.done()
+        s = b.stats()
+        assert s["inflight_batches"] == 2 and s["inflight_rows"] == 2
+        assert s["dispatched_batches"] == 2 and s["batches"] == 0
+        d.release_all()
+        np.testing.assert_array_equal(f1.result(5), fake_rows(imgs(1)))
+        np.testing.assert_array_equal(f2.result(5), fake_rows(imgs(2)))
+    finally:
+        d.release_all()
+        b.close()
+    assert b.stats()["max_inflight_observed"] == 2
+
+
+def test_inflight_batch_count_bound_enforced():
+    d = GatedDispatch()
+    b = make(d, max_inflight=2)
+    try:
+        futs = [b.submit(imgs(i)) for i in range(4)]
+        assert wait_until(lambda: d.count() == 2)
+        time.sleep(0.05)  # window full: the 3rd batch must NOT dispatch
+        assert d.count() == 2
+        d.handles[0].gate.set()  # one completes -> exactly one more dispatches
+        assert wait_until(lambda: d.count() == 3)
+        time.sleep(0.05)
+        assert d.count() == 3
+        d.release_all()
+        for i, f in enumerate(futs):
+            np.testing.assert_array_equal(f.result(5), fake_rows(imgs(i)))
+    finally:
+        d.release_all()
+        b.close()
+
+
+def test_inflight_row_bound_enforced_under_load():
+    """The HBM cap: max_inflight alone admits 8 batches, but the ROW bound
+    (5) must hold dispatch at 2 two-row batches until one lands."""
+    d = GatedDispatch()
+    b = make(d, max_inflight=8, max_inflight_images=5)
+    try:
+        futs = [b.submit(imgs(i, i)) for i in range(4)]  # 2 rows each
+        assert wait_until(lambda: d.count() == 2)  # 2+2 <= 5 < 2+2+2
+        time.sleep(0.05)
+        assert d.count() == 2 and b.stats()["inflight_rows"] == 4
+        d.handles[0].gate.set()
+        assert wait_until(lambda: d.count() == 3)
+        d.release_all()
+        for i, f in enumerate(futs):
+            np.testing.assert_array_equal(f.result(5), fake_rows(imgs(i, i)))
+    finally:
+        d.release_all()
+        b.close()
+
+
+def test_oversized_batch_admitted_alone_not_deadlocked():
+    """A single batch larger than max_inflight_images must dispatch when the
+    window is empty (the engine chunks it) instead of waiting forever."""
+    d = GatedDispatch()
+    b = make(d, max_inflight=2, max_inflight_images=3)
+    try:
+        f = b.submit(imgs(1, 2, 3, 4, 5))  # 5 rows > bound 3
+        assert wait_until(lambda: d.count() == 1)
+        d.release_all()
+        np.testing.assert_array_equal(
+            f.result(5), fake_rows(imgs(1, 2, 3, 4, 5))
+        )
+    finally:
+        d.release_all()
+        b.close()
+
+
+def test_completion_is_fifo_in_dispatch_order():
+    """Releasing batch 2 FIRST must not resolve it before batch 1 — the
+    completer preserves dispatch order end to end."""
+    d = GatedDispatch()
+    b = make(d, max_inflight=2)
+    try:
+        f1 = b.submit(imgs(1))
+        f2 = b.submit(imgs(2))
+        assert wait_until(lambda: d.count() == 2)
+        d.handles[1].gate.set()  # batch 2 "lands" first
+        time.sleep(0.05)
+        assert not f2.done()  # still behind batch 1
+        d.handles[0].gate.set()
+        np.testing.assert_array_equal(f1.result(5), fake_rows(imgs(1)))
+        np.testing.assert_array_equal(f2.result(5), fake_rows(imgs(2)))
+    finally:
+        d.release_all()
+        b.close()
+
+
+# ------------------------------------------------------------- lifecycle
+
+
+def test_close_drains_inflight_batches_cleanly():
+    """close() with batches still in flight: no hung futures, no deadlock
+    (a background release models the device finishing mid-close)."""
+    d = GatedDispatch()
+    b = make(d, max_inflight=2)
+    futs = [b.submit(imgs(i)) for i in range(3)]
+    assert wait_until(lambda: d.count() == 2)
+    releaser = threading.Timer(0.05, d.release_all)
+    releaser.start()
+
+    def late_release():
+        # the 3rd batch dispatches during the drain; keep releasing
+        wait_until(lambda: d.count() == 3)
+        d.release_all()
+
+    t = threading.Thread(target=late_release)
+    t.start()
+    b.close()  # must return with everything resolved
+    releaser.join()
+    t.join()
+    for i, f in enumerate(futs):
+        np.testing.assert_array_equal(f.result(0), fake_rows(imgs(i)))
+
+
+def test_close_without_drain_still_completes_dispatched_batches():
+    """drain=False fails requests still in the PENDING queue, but work that
+    already left it — the in-flight batch, and the batch the assembler has
+    popped and is holding for window room — completes: its compute is spent
+    (or committed) and its waiters are real."""
+    d = GatedDispatch()
+    b = make(d, max_inflight=1)
+    in_flight = b.submit(imgs(1))
+    assert wait_until(lambda: d.count() == 1)
+    held = b.submit(imgs(2))  # popped by the assembler, waiting for room
+    queued = b.submit(imgs(3))  # stays pending while the assembler holds #2
+    assert wait_until(lambda: b.stats()["queue_depth"] == 1)
+    assert d.count() == 1  # window of 1 is full: #2 not dispatched yet
+    threading.Timer(0.05, d.release_all).start()
+    b.close(drain=False)
+    np.testing.assert_array_equal(in_flight.result(5), fake_rows(imgs(1)))
+    np.testing.assert_array_equal(held.result(5), fake_rows(imgs(2)))
+    with pytest.raises(RuntimeError, match="closed"):
+        queued.result(0)
+
+
+def test_dispatch_error_fails_batch_immediately():
+    def broken(images):
+        raise ValueError("dispatch exploded")
+
+    b = DynamicBatcher(dispatch_fn=broken, max_batch=8, max_wait_ms=0)
+    try:
+        fut = b.submit(imgs(1))
+        with pytest.raises(ValueError, match="dispatch exploded"):
+            fut.result(5)
+        assert b.stats()["errors"] >= 1
+    finally:
+        b.close()
+
+
+def test_completion_error_fails_waiters_and_frees_the_window():
+    class BrokenHandle:
+        def result(self):
+            raise RuntimeError("D2H exploded")
+
+    b = make(lambda images: BrokenHandle(), max_inflight=1)
+    try:
+        f1 = b.submit(imgs(1))
+        f2 = b.submit(imgs(2))  # must still dispatch after f1's failure
+        for f in (f1, f2):
+            with pytest.raises(RuntimeError, match="D2H exploded"):
+                f.result(5)
+        s = b.stats()
+        assert s["errors"] == 2 and s["inflight_batches"] == 0
+    finally:
+        b.close()
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError, match="embed_fn or dispatch_fn"):
+        DynamicBatcher()
+    with pytest.raises(ValueError, match="not both"):
+        DynamicBatcher(fake_rows, dispatch_fn=GatedDispatch())
+    with pytest.raises(ValueError, match="max_inflight"):
+        DynamicBatcher(fake_rows, max_inflight=0)
+    with pytest.raises(ValueError, match="max_inflight"):
+        DynamicBatcher(fake_rows, max_inflight_images=0)
+
+
+def test_occupancy_gauges_present_and_bounded():
+    d = GatedDispatch()
+    b = make(d, max_inflight=2)
+    try:
+        f = b.submit(imgs(1))
+        assert wait_until(lambda: d.count() == 1)
+        time.sleep(0.03)  # accrue busy time while one batch is in flight
+        s = b.stats()
+        assert s["inflight_batches"] == 1
+        assert 0.0 < s["pipeline_occupancy"] <= 1.0
+        assert 0.0 < s["avg_inflight_depth"] <= 2.0
+        d.release_all()
+        f.result(5)
+    finally:
+        d.release_all()
+        b.close()
+    s = b.stats()
+    assert s["max_inflight"] == 2 and s["max_inflight_images"] == 4096
+
+
+# ---------------------------------------------- real engine, real threads
+
+
+SIZE = 8
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from simclr_pytorch_distributed_tpu.serve.engine import EmbeddingEngine
+
+    return EmbeddingEngine.random_init(
+        model_name="resnet10", size=SIZE, buckets=(2, 8)
+    )
+
+
+def real_images(rng, n):
+    return rng.integers(0, 256, size=(n, SIZE, SIZE, 3), dtype=np.uint8)
+
+
+def test_concurrent_mixed_sizes_match_sequential_embed(engine):
+    """Satellite stress: N threads × mixed request sizes through the
+    pipelined batcher == sequential engine.embed, within the pinned
+    cross-bucket allclose contract (coalescing may route a request through
+    a different bucket program than its solo embed took)."""
+    rng = np.random.default_rng(0)
+    requests = [real_images(rng, int(n)) for n in rng.integers(1, 9, size=24)]
+    expected = [engine.embed(x) for x in requests]
+
+    b = DynamicBatcher(
+        dispatch_fn=engine.dispatch, max_batch=8, max_wait_ms=2,
+        max_inflight=3, max_inflight_images=64,
+        validate=engine.validate_images,
+    )
+    results = [None] * len(requests)
+    errors = []
+
+    def client(k):
+        try:
+            results[k] = b.submit(requests[k]).result(timeout=60)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append((k, e))
+
+    threads = [
+        threading.Thread(target=client, args=(k,))
+        for k in range(len(requests))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    b.close()
+    assert not errors, errors
+    for k, (got, want) in enumerate(zip(results, expected)):
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"request {k}")
+    assert b.stats()["errors"] == 0
+
+
+def test_real_engine_close_with_inflight_drains(engine):
+    """close() racing live device work: every submitted future resolves."""
+    rng = np.random.default_rng(1)
+    b = DynamicBatcher(
+        dispatch_fn=engine.dispatch, max_batch=8, max_wait_ms=1,
+        max_inflight=3, validate=engine.validate_images,
+    )
+    futs = [b.submit(real_images(rng, 4)) for _ in range(6)]
+    b.close()  # drain: returns only after the pipeline is empty
+    for f in futs:
+        assert f.done()
+        assert f.result(0).shape == (4, 512)

@@ -3,8 +3,10 @@
 The handler is bound to a DynamicBatcher + a ``stats_fn`` callable, so these
 tests drive the REAL wire protocol (status codes, both JSON image encodings,
 backpressure/timeout mapping) through a per-row fake embed function — no jax
-compiles. The full engine→batcher→HTTP path runs in
-``scripts/serve_bench.py --smoke`` (tests/test_scripts.py).
+compiles, except the one CLI-plumbing test that builds the real
+``--dtype bf16`` stack through ``build_stack``. The full
+engine→batcher→HTTP path runs in ``scripts/serve_bench.py --smoke``
+(tests/test_scripts.py).
 """
 
 import base64
@@ -161,6 +163,47 @@ def test_oversized_content_length_replies_400_and_closes_connection():
         assert resp.getheader("Connection") == "close"
         resp.read()
         conn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+        batcher.close()
+
+
+@pytest.mark.serve
+def test_build_stack_cli_plumbing_bf16_and_pipeline_knobs():
+    """--dtype bf16 / --max_inflight reach the engine and batcher through
+    the CLI parser, and one real request flows through the full pipelined
+    stack (assembler -> inflight window -> completer -> HTTP). The one test
+    in this file that compiles (a single bf16 bucket-2 program)."""
+    from simclr_pytorch_distributed_tpu.serve.server import (
+        build_parser,
+        build_stack,
+    )
+
+    args = build_parser().parse_args([
+        "--model", "resnet10", "--buckets", "2", "--img_size", "8",
+        "--dtype", "bf16", "--max_inflight", "3",
+        "--max_inflight_images", "64", "--max_wait_ms", "1", "--port", "0",
+    ])
+    engine, batcher, server = build_stack(args)
+    try:
+        assert engine.dtype == "bf16"
+        s = batcher.stats()
+        assert s["max_inflight"] == 3 and s["max_inflight_images"] == 64
+        start_in_thread(server)
+        host, port = server.server_address[:2]
+        base = f"http://{host}:{port}"
+        images = np.zeros((1, 8, 8, 3), np.uint8)
+        status, reply = post(base, "/embed", {"images": images.tolist()},
+                             timeout=120)
+        assert status == 200
+        assert reply["dim"] == 512 and reply["n"] == 1
+        assert np.isfinite(np.asarray(reply["embeddings"])).all()
+        status, stats = get(base, "/stats")
+        assert stats["engine"]["dtype"] == "bf16"
+        assert stats["batcher"]["dispatched_batches"] >= 1
+        assert "inflight_batches" in stats["batcher"]
+        assert "pipeline_occupancy" in stats["batcher"]
     finally:
         server.shutdown()
         server.server_close()
