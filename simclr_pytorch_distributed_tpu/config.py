@@ -70,7 +70,11 @@ class SupConConfig:
     l2reg: bool = False
     l2reg_wei: float = 0.0
     ckpt: str = ""
-    ngpu: int = 2  # grad-scale equivalence divisor (reference --ngpu default)
+    # grad-scale equivalence divisor (reference --ngpu default 2); also
+    # accepts 'auto' = resolve to the mesh's data-parallel size at startup
+    # (resolve_ngpu). A non-auto mismatch prints a startup banner naming the
+    # effective-LR consequence (ngpu_mismatch_banner).
+    ngpu: object = 2
     # head (reference hardcodes SupConResNet defaults, resnet_big.py:161)
     head: str = "mlp"
     feat_dim: int = 128
@@ -110,6 +114,11 @@ class SupConConfig:
     # per-block activation rematerialization: trades recompute FLOPs for HBM
     # so bigger per-chip batches fit (identical numerics; models/resnet.py)
     remat: bool = False
+    # where the per-window metric flush (D2H + NaN check + meters + TB) runs:
+    # 'async' = background telemetry thread, zero sync on the hot loop (NaN
+    # detection at most one print_freq window late — utils/telemetry.py);
+    # 'sync' = inline on the dispatch thread (the pre-ring semantics)
+    telemetry: str = "async"
     # derived (finalize_supcon)
     warm_epochs: int = 10
     warmup_from: float = 0.01
@@ -130,6 +139,62 @@ def _parse_bool(s: str) -> bool:
     if v in ("0", "false", "no", "off"):
         return False
     raise argparse.ArgumentTypeError(f"expected a boolean, got {s!r}")
+
+
+def ngpu_arg(s: str):
+    """--ngpu accepts the reference's int OR 'auto' (mesh-resolved)."""
+    if s.strip().lower() == "auto":
+        return "auto"
+    try:
+        v = int(s)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--ngpu expects a positive integer or 'auto', got {s!r}"
+        ) from None
+    if v <= 0:
+        # it becomes the gradient DIVISOR: 0 divides by zero, negatives
+        # flip the update direction — reject at parse, not mid-startup
+        raise argparse.ArgumentTypeError(f"--ngpu must be positive, got {v}")
+    return v
+
+
+def resolve_ngpu(ngpu, data_parallel: int) -> int:
+    """The effective grad divisor: ``'auto'`` -> the mesh's data-parallel
+    size; integers (or int-like strings from restored config dicts) pass
+    through unchanged."""
+    if isinstance(ngpu, str) and ngpu.strip().lower() == "auto":
+        return int(data_parallel)
+    v = int(ngpu)
+    if v <= 0:  # programmatic configs bypass ngpu_arg
+        raise ValueError(f"ngpu must be positive, got {v}")
+    return v
+
+
+def ngpu_mismatch_banner(ngpu: int, data_parallel: int, learning_rate: float) -> str:
+    """Startup banner for an explicit --ngpu that differs from the mesh.
+
+    The step divides the exact global-batch gradient by ``ngpu`` (DDP
+    grad-mean fidelity with the reference's ``ngpu``-GPU runs,
+    train/supcon_step.py). When the mesh's data-parallel size differs, that
+    divisor no longer matches the hardware, which silently rescales the
+    effective learning rate — worth a banner, not a log line lost in startup
+    noise (VERDICT round 5 #8).
+    """
+    eff = learning_rate * data_parallel / ngpu
+    bar = "=" * 72
+    return (
+        f"\n{bar}\n"
+        f"  --ngpu {ngpu} but the mesh is data-parallel over {data_parallel} "
+        f"device(s).\n"
+        f"  Gradients are divided by {ngpu} (recipe fidelity with the "
+        f"reference's {ngpu}-GPU runs): relative to mesh-matched scaling the "
+        f"applied update is {data_parallel}/{ngpu} = "
+        f"{data_parallel / ngpu:.3g}x, i.e. an EFFECTIVE learning rate of "
+        f"~{eff:.4g} instead of the configured {learning_rate:g}.\n"
+        f"  Pass --ngpu auto (or --ngpu {data_parallel}) to scale with this "
+        f"mesh instead.\n"
+        f"{bar}"
+    )
 
 
 def supcon_parser() -> argparse.ArgumentParser:
@@ -171,7 +236,9 @@ def supcon_parser() -> argparse.ArgumentParser:
     _add_bool_flag(p, "l2reg")
     p.add_argument("--l2reg_wei", type=float, default=d.l2reg_wei)
     p.add_argument("--ckpt", type=str, default=d.ckpt)
-    p.add_argument("--ngpu", type=int, default=d.ngpu)
+    p.add_argument("--ngpu", type=ngpu_arg, default=d.ngpu,
+                   help="DDP grad-mean divisor (reference fidelity), or "
+                        "'auto' = the mesh's data-parallel size")
     p.add_argument("--head", type=str, default=d.head, choices=["mlp", "linear"])
     p.add_argument("--feat_dim", type=int, default=d.feat_dim)
     _add_bool_flag(p, "bf16")
@@ -196,6 +263,10 @@ def supcon_parser() -> argparse.ArgumentParser:
                    choices=["abort", "rollback"],
                    help="on NaN loss: die after the crash save, or restore "
                         "the epoch backup, halve the LR, and continue")
+    p.add_argument("--telemetry", type=str, default=d.telemetry,
+                   choices=["async", "sync"],
+                   help="metric flush: background thread (zero sync on the "
+                        "hot loop; NaN detection <=1 window late) or inline")
     return p
 
 
@@ -280,6 +351,7 @@ class LinearConfig:
     workdir: str = "./work_space"
     trial: str = "0"
     compile_cache: str = "auto"  # same semantics as the pretrain flag
+    telemetry: str = "async"  # same semantics as the pretrain flag
     # derived
     n_cls: int = 10
     warm_epochs: int = 10
@@ -329,6 +401,9 @@ def linear_parser(ce: bool = False) -> argparse.ArgumentParser:
     p.add_argument("--workdir", type=str, default=d.workdir)
     p.add_argument("--trial", type=str, default=d.trial)
     p.add_argument("--compile_cache", type=str, default=d.compile_cache)
+    p.add_argument("--telemetry", type=str, default=d.telemetry,
+                   choices=["async", "sync"],
+                   help="metric flush: background thread or inline")
     return p
 
 

@@ -8,10 +8,11 @@ averaging in the epoch drivers.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def topk_accuracy(
@@ -59,6 +60,11 @@ class MetricBuffer:
     to ~1/print_freq of the steps. Buffering gives both: every step is metered
     and TB-logged at reference cadence, with one transfer per flush instead of
     one per step.
+
+    As of the zero-sync telemetry round the trainers all write the
+    :class:`MetricRing` instead; this class has NO production callers and is
+    retained only as the compile-free pre-ring reference implementation (and
+    the fallback for a future caller whose step can't thread a ring buffer).
     """
 
     def __init__(self) -> None:
@@ -83,4 +89,109 @@ class MetricBuffer:
             for (info, _), row in zip(self._steps, fetched)
         ]
         self._steps = []
+        return out
+
+
+class MetricRing:
+    """Device-side ``[window, K]`` fp32 metric ring + its host bookkeeping.
+
+    :class:`MetricBuffer` already batches the per-window readback into one
+    ``device_get`` *call*, but each buffered step still holds ~K live device
+    scalars, so the runtime issues one tiny D2H descriptor per scalar —
+    ~window*K transfers per flush (~110 ms/window on a tunneled link,
+    docs/PERF.md round 5). The ring closes that: the jitted step writes its
+    metrics into row ``step % window`` of ONE device array
+    (:meth:`write`, a ``dynamic_update_slice`` inside the compiled program,
+    carried with the train state under the same donation discipline), and a
+    flush is ONE contiguous D2H of that single small array
+    (:meth:`resolve`). The host side records which ``(info, step)`` pairs are
+    pending (:meth:`append` / :meth:`take_window`) and slices their rows out
+    of the fetched block.
+
+    ``device_get`` is injectable so tests can count transfers mechanically
+    (``self.transfers`` counts flushes; each is exactly one call) or gate
+    them on an event to prove dispatch/flush overlap.
+    """
+
+    def __init__(
+        self,
+        window: int,
+        keys: Sequence[str],
+        device_get: Optional[Callable] = None,
+    ) -> None:
+        if window <= 0:
+            raise ValueError(f"ring window must be positive, got {window}")
+        if len(set(keys)) != len(keys):
+            raise ValueError(f"duplicate metric keys: {sorted(keys)}")
+        self.window = int(window)
+        # fixed column order shared by the trace-time writer and the host
+        # reader — sorted so both sides derive it from the key SET alone
+        self.keys = tuple(sorted(keys))
+        self._device_get = device_get if device_get is not None else jax.device_get
+        self._pending = []  # [(info, global_step)] appended, not yet flushed
+        self.transfers = 0  # host transfers performed (== completed flushes)
+
+    def init_buffer(self, sharding=None) -> jax.Array:
+        """A fresh (zero) ring buffer; create one per epoch — the ring is
+        transient driver state and is never checkpointed. ``sharding`` (the
+        mesh's replicated sharding in the drivers) places the buffer where
+        the jitted update expects it, so the first donation of each epoch
+        doesn't relayout."""
+        buf = jnp.zeros((self.window, len(self.keys)), jnp.float32)
+        return buf if sharding is None else jax.device_put(buf, sharding)
+
+    def write(self, ring: jax.Array, metrics: dict, step) -> jax.Array:
+        """Trace-time: write ``metrics`` into row ``step % window``.
+
+        Called INSIDE the jitted update with the traced ``state.step`` (the
+        pre-increment global step), so the slot needs no extra carried
+        counter and no host->device scalar per call.
+        """
+        if tuple(sorted(metrics)) != self.keys:
+            raise ValueError(
+                f"metric keys {sorted(metrics)} != ring keys {list(self.keys)}"
+            )
+        row = jnp.stack(
+            [jnp.asarray(metrics[k]).astype(jnp.float32) for k in self.keys]
+        )
+        slot = jnp.asarray(step, jnp.int32) % self.window
+        return jax.lax.dynamic_update_slice(
+            ring, row[None, :], (slot, jnp.zeros((), jnp.int32))
+        )
+
+    def append(self, info, step: int) -> None:
+        """Record that the step just dispatched wrote slot ``step % window``."""
+        if len(self._pending) >= self.window:
+            raise RuntimeError(
+                f"metric ring overflow: {len(self._pending)} steps pending in "
+                f"a window of {self.window} — flush at least every "
+                f"{self.window} steps"
+            )
+        self._pending.append((info, int(step)))
+
+    def pending_count(self) -> int:
+        """Steps appended since the last flush (the current window's size)."""
+        return len(self._pending)
+
+    def take_window(self):
+        """Hand the pending ``(info, step)`` list to a flush; clears it."""
+        pending, self._pending = self._pending, []
+        return pending
+
+    def resolve(self, snapshot: jax.Array, pending):
+        """ONE host transfer of the whole ring; returns ``[(info, {k: float})]``.
+
+        ``snapshot`` must be a buffer later steps cannot donate away — the
+        drivers hand a device-side copy taken at the window boundary.
+        """
+        if not pending:
+            return []
+        self.transfers += 1
+        host = np.asarray(self._device_get(snapshot))
+        out = []
+        for info, step in pending:
+            row = host[step % self.window]
+            out.append(
+                (info, {k: float(row[i]) for i, k in enumerate(self.keys)})
+            )
         return out

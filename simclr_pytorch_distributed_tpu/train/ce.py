@@ -32,7 +32,7 @@ from simclr_pytorch_distributed_tpu.ops.augment import (
     eval_batch,
 )
 from simclr_pytorch_distributed_tpu.ops.losses import cross_entropy_loss
-from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter, MetricBuffer
+from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
     batch_sharding,
@@ -44,7 +44,13 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
     shard_host_batch,
     sync_processes,
 )
-from simclr_pytorch_distributed_tpu.train.linear import run_validation, stats_for, topk_correct
+from simclr_pytorch_distributed_tpu.train.linear import (
+    PROBE_METRIC_KEYS,
+    jit_scalar_or_ring_step,
+    run_validation,
+    stats_for,
+    topk_correct,
+)
 from simclr_pytorch_distributed_tpu.train.supcon import enable_compile_cache
 from simclr_pytorch_distributed_tpu.utils import preempt
 from simclr_pytorch_distributed_tpu.utils.checkpoint import (
@@ -55,6 +61,7 @@ from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     wait_for_saves,
 )
 from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
+from simclr_pytorch_distributed_tpu.utils.telemetry import TelemetrySession
 
 
 class CEState(struct.PyTreeNode):
@@ -64,7 +71,10 @@ class CEState(struct.PyTreeNode):
     opt_state: Any
 
 
-def make_ce_steps(model, tx, aug_cfg, mesh):
+def make_ce_steps(model, tx, aug_cfg, mesh, metric_ring=None):
+    """``metric_ring`` switches the train step to ring telemetry (see
+    train/supcon.make_fused_update); ``None`` keeps the scalar-returning
+    signature (bench.py)."""
     repl = replicated_sharding(mesh)
 
     def train_step(state: CEState, images_u8, labels, base_key):
@@ -108,12 +118,7 @@ def make_ce_steps(model, tx, aug_cfg, mesh):
             "n": jnp.sum(valid),
         }
 
-    train_jit = jax.jit(
-        train_step,
-        in_shardings=(repl, batch_sharding(mesh, 4), batch_sharding(mesh, 1), repl),
-        out_shardings=(repl, repl),
-        donate_argnums=(0,),
-    )
+    train_jit = jit_scalar_or_ring_step(train_step, metric_ring, mesh)
     eval_jit = jax.jit(
         eval_step,
         in_shardings=(repl, batch_sharding(mesh, 4), batch_sharding(mesh, 1),
@@ -174,7 +179,11 @@ def run(cfg: config_lib.LinearConfig):
 
     mean, std = stats_for(cfg.dataset)
     aug_cfg = AugmentConfig(size=cfg.size, mean=mean, std=std, color_ops=False)
-    train_jit, eval_jit = make_ce_steps(model, tx, aug_cfg, mesh)
+    # device-side metric ring + background flush (utils/telemetry.py)
+    telemetry = TelemetrySession(cfg.print_freq, PROBE_METRIC_KEYS, cfg.telemetry)
+    train_jit, eval_jit = make_ce_steps(
+        model, tx, aug_cfg, mesh, metric_ring=telemetry.ring
+    )
 
     start_epoch, start_step = 1, 0
     meta = {}
@@ -213,43 +222,62 @@ def run(cfg: config_lib.LinearConfig):
         for epoch in range(start_epoch, cfg.epochs + 1):
             t1 = time.time()
             losses, top1 = AverageMeter(), AverageMeter()
-            buffer = MetricBuffer()
+            ring_buf = telemetry.init_buffer(replicated_sharding(mesh))
 
-            def fold_metrics():
-                # one batched readback; every step reaches the meters
-                for _, m in buffer.flush():
-                    losses.update(m["loss"], cfg.batch_size)
-                    top1.update(100.0 * m["top1"] / cfg.batch_size, cfg.batch_size)
+            def submit_window(boundary_idx, ring_buf, step_hint):
+                # one flush_boundary (utils/telemetry.py): snapshot + queue
+                # the one-transfer flush (meters/log run on the telemetry
+                # thread, FIFO), observe failures collectively
+                def consume(fetched):
+                    for _, m in fetched:
+                        losses.update(m["loss"], cfg.batch_size)
+                        top1.update(100.0 * m["top1"] / cfg.batch_size, cfg.batch_size)
+                    logging.info(
+                        "Train: [%d][%d/%d]\tloss %.3f (%.3f)\tAcc@1 %.3f (%.3f)",
+                        epoch, boundary_idx + 1, steps_per_epoch,
+                        losses.val, losses.avg, top1.val, top1.avg,
+                    )
+
+                telemetry.flush_boundary(ring_buf, consume,
+                                         step_hint=step_hint)
 
             ss = start_step if epoch == start_epoch else 0
             for idx, (images_u8, labels) in enumerate(
                 loader.epoch(epoch, start_step=ss), start=ss
             ):
+                gstep = (epoch - 1) * steps_per_epoch + idx  # == state.step
                 batch = shard_host_batch((images_u8, labels), mesh)
-                state, m = train_jit(state, batch[0], batch[1], base_key)
-                buffer.append(idx, m)
+                state, ring_buf = train_jit(
+                    state, ring_buf, batch[0], batch[1], base_key
+                )
+                telemetry.append(idx, gstep)
                 if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
-                    fold_metrics()
-                    logging.info(
-                        "Train: [%d][%d/%d]\tloss %.3f (%.3f)\tAcc@1 %.3f (%.3f)",
-                        epoch, idx + 1, steps_per_epoch,
-                        losses.val, losses.avg, top1.val, top1.avg,
-                    )
+                    submit_window(idx, ring_buf, gstep)
                     if idx + 1 < steps_per_epoch and preempt.requested_global():
                         # SIGTERM/SIGINT at a flush boundary, decided
-                        # collectively (see train/supcon.py): metrics are
-                        # drained; emergency mid-epoch save (collective, same
-                        # semantics as the pretrain driver) and the distinct
-                        # exit code tell the launcher to re-run with --resume.
+                        # collectively on the MAIN thread (see
+                        # train/supcon.py — independent of any in-flight
+                        # flush). Drain COLLECTIVELY (a host-local raise
+                        # here would skip the collective emergency save
+                        # while peers enter it) so the mid-epoch save —
+                        # collective, same semantics as the pretrain driver
+                        # — sees complete metrics; the distinct exit code
+                        # tells the launcher to re-run with --resume.
+                        telemetry.drain_global(gstep)
                         preempt.emergency_save_and_exit(
                             cfg.save_folder,
                             f"preempt_epoch_{epoch}_step_{idx + 1}",
                             state_for_save(state),
                             config_lib.config_dict(cfg), epoch - 1,
                             step_in_epoch=idx + 1, extra_meta=run_meta(),
-                            cleanup=(tb.close,),
+                            cleanup=(tb.close, telemetry.close),
                         )
-            fold_metrics()
+            # flush any short-epoch tail, then drain COLLECTIVELY ahead of
+            # the scheduled save (the ordering contract lives on the session)
+            telemetry.finish_epoch(
+                lambda hint: submit_window(steps_per_epoch - 1, ring_buf, hint),
+                epoch * steps_per_epoch - 1,
+            )
             logging.info("Train epoch %d, total time %.2f, accuracy:%.2f",
                          epoch, time.time() - t1, top1.avg)
 
@@ -284,11 +312,13 @@ def run(cfg: config_lib.LinearConfig):
                     None if epoch % cfg.save_freq == 0
                     else f"preempt_epoch_{epoch}",
                     state_for_save(state), config_lib.config_dict(cfg),
-                    epoch, extra_meta=run_meta(), cleanup=(tb.close,),
+                    epoch, extra_meta=run_meta(),
+                    cleanup=(tb.close, telemetry.close),
                 )
 
     finally:
         preempt.uninstall()
+        telemetry.close()
     wait_for_saves()
     logging.info("best accuracy: %.2f, accuracy5: %.2f", best_acc, best_acc5)
     tb.close()

@@ -48,7 +48,7 @@ from simclr_pytorch_distributed_tpu.ops.augment import (
     eval_batch,
 )
 from simclr_pytorch_distributed_tpu.ops.losses import cross_entropy_loss
-from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter, MetricBuffer
+from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
     batch_sharding,
@@ -68,6 +68,10 @@ from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     save_classifier,
 )
 from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
+from simclr_pytorch_distributed_tpu.utils.telemetry import TelemetrySession
+
+# ring columns for the probe/CE step metrics (ops/metrics.MetricRing)
+PROBE_METRIC_KEYS = ("loss", "top1", "top5")
 
 
 class ProbeState(struct.PyTreeNode):
@@ -120,7 +124,41 @@ def topk_correct(logits: jax.Array, labels: jax.Array, ks=(1, 5)):
     return {k: jnp.sum(jnp.any(hit[:, :k], axis=1)) for k in ks}
 
 
-def make_probe_steps(classifier, tx, encode, aug_cfg, eval_cfg, mesh):
+def jit_scalar_or_ring_step(step_fn, metric_ring, mesh):
+    """Jit a ``(state, images_u8, labels, key) -> (state, metrics)`` train
+    step for a probe-style driver. With ``metric_ring`` the step is wrapped
+    to write its metrics into the donated device ring at ``state.step``
+    (``(state, ring, images, labels, key) -> (state, ring)``, see
+    train/supcon.make_fused_update); ``None`` keeps the scalar-returning
+    signature (bench.py). Shared by the probe and CE builders so the ring
+    wiring (shardings + donation) cannot diverge between them."""
+    repl = replicated_sharding(mesh)
+    data = (batch_sharding(mesh, 4), batch_sharding(mesh, 1))
+    if metric_ring is None:
+        return jax.jit(
+            step_fn,
+            in_shardings=(repl, *data, repl),
+            out_shardings=(repl, repl),
+            donate_argnums=(0,),
+        )
+
+    def ring_step(state, ring, images_u8, labels, base_key):
+        new_state, metrics = step_fn(state, images_u8, labels, base_key)
+        return new_state, metric_ring.write(ring, metrics, state.step)
+
+    return jax.jit(
+        ring_step,
+        in_shardings=(repl, repl, *data, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=(0, 1),
+    )
+
+
+def make_probe_steps(classifier, tx, encode, aug_cfg, eval_cfg, mesh, metric_ring=None):
+    """``metric_ring`` switches the train step to ring telemetry —
+    ``(state, ring, images, labels, key) -> (state, ring)`` with the metrics
+    written on device (see train/supcon.make_fused_update); ``None`` keeps
+    the scalar-returning signature (bench.py)."""
     repl = replicated_sharding(mesh)
 
     def train_step(state: ProbeState, images_u8, labels, base_key):
@@ -155,12 +193,7 @@ def make_probe_steps(classifier, tx, encode, aug_cfg, eval_cfg, mesh):
         top5 = jnp.sum(jnp.any(maxk_hit, axis=1) * valid)
         return {"loss_sum": loss_sum, "top1": top1, "top5": top5, "n": jnp.sum(valid)}
 
-    train_jit = jax.jit(
-        train_step,
-        in_shardings=(repl, batch_sharding(mesh, 4), batch_sharding(mesh, 1), repl),
-        out_shardings=(repl, repl),
-        donate_argnums=(0,),
-    )
+    train_jit = jit_scalar_or_ring_step(train_step, metric_ring, mesh)
     eval_jit = jax.jit(
         eval_step,
         in_shardings=(repl, batch_sharding(mesh, 4), batch_sharding(mesh, 1),
@@ -246,8 +279,12 @@ def run(cfg: config_lib.LinearConfig):
     )
     mean, std = stats_for(cfg.dataset)
     aug_cfg = AugmentConfig(size=cfg.size, mean=mean, std=std, color_ops=False)
+    # device-side metric ring + background flush (utils/telemetry.py): the
+    # probe step is SMALL, so the per-window sync flush was a proportionally
+    # bigger slice of its loop than the pretrain driver's
+    telemetry = TelemetrySession(cfg.print_freq, PROBE_METRIC_KEYS, cfg.telemetry)
     train_jit, eval_jit = make_probe_steps(
-        classifier, tx, encode, aug_cfg, aug_cfg, mesh
+        classifier, tx, encode, aug_cfg, aug_cfg, mesh, metric_ring=telemetry.ring
     )
 
     tb = TBLogger(cfg.tb_folder, enabled=is_main_process())
@@ -273,38 +310,54 @@ def run(cfg: config_lib.LinearConfig):
             t1 = time.time()
             losses, top1, top5 = AverageMeter(), AverageMeter(), AverageMeter()
             bt = AverageMeter()
-            buffer = MetricBuffer()
             bsz = cfg.batch_size
+            ring_buf = telemetry.init_buffer(replicated_sharding(mesh))
+            telemetry.start_window_clock()
 
-            def fold_metrics():
-                # one batched readback; every step reaches the meters
-                for _, m in buffer.flush():
-                    losses.update(m["loss"], bsz)
-                    top1.update(100.0 * m["top1"] / bsz, bsz)
-                    top5.update(100.0 * m["top5"] / bsz, bsz)
-
-            end = time.time()
-            for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
-                batch = shard_host_batch((images_u8, labels), mesh)
-                state, m = train_jit(state, batch[0], batch[1], base_key)
-                buffer.append(idx, m)
-                if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
-                    fold_metrics()
-                    bt.update(time.time() - end)
+            def submit_window(boundary_idx, ring_buf, step_hint):
+                # one flush_boundary (utils/telemetry.py): meter the window
+                # on the main thread, snapshot + queue the one-transfer
+                # flush, observe failures collectively
+                def consume(fetched, bt):
+                    # ``bt`` shadows the meter with the (val, avg) tuple
+                    # flush_boundary snapshotted on the main thread — the
+                    # live meter keeps mutating while this job runs
+                    for _, m in fetched:
+                        losses.update(m["loss"], bsz)
+                        top1.update(100.0 * m["top1"] / bsz, bsz)
+                        top5.update(100.0 * m["top5"] / bsz, bsz)
                     logging.info(
                         "Train: [%d][%d/%d]\tBT %.3f (%.3f)\tloss %.3f (%.3f)\t"
                         "Acc@1 %.3f (%.3f)",
-                        epoch, idx + 1, steps_per_epoch, bt.val, bt.avg,
+                        epoch, boundary_idx + 1, steps_per_epoch, bt[0], bt[1],
                         losses.val, losses.avg, top1.val, top1.avg,
                     )
+
+                telemetry.flush_boundary(ring_buf, consume, batch_meter=bt,
+                                         step_hint=step_hint)
+
+            for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
+                gstep = (epoch - 1) * steps_per_epoch + idx  # == state.step
+                batch = shard_host_batch((images_u8, labels), mesh)
+                state, ring_buf = train_jit(
+                    state, ring_buf, batch[0], batch[1], base_key
+                )
+                telemetry.append(idx, gstep)
+                if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
+                    submit_window(idx, ring_buf, gstep)
                     if preempt.requested_global():
-                        # collective decision (see train/supcon.py): all
-                        # hosts leave the loop at the same flush boundary,
+                        # collective decision (see train/supcon.py), on the
+                        # MAIN thread — independent of any in-flight flush:
+                        # all hosts leave the loop at the same boundary,
                         # keeping the end-of-run barriers matched
                         preempted = True
                         break
-                end = time.time()
-            fold_metrics()
+            # flush any short-epoch tail, then drain COLLECTIVELY ahead of
+            # the end-of-run save (the ordering contract lives on the session)
+            telemetry.finish_epoch(
+                lambda hint: submit_window(steps_per_epoch - 1, ring_buf, hint),
+                epoch * steps_per_epoch - 1,
+            )
             if preempted:
                 logging.warning(
                     "preempted (%s) during epoch %d: stopping the probe",
@@ -334,6 +387,7 @@ def run(cfg: config_lib.LinearConfig):
                 best_params = jax.device_get(state.params)
     finally:
         preempt.uninstall()
+        telemetry.close()
 
     if best_params is not None:
         # beyond parity: persist the best probe head (the reference only

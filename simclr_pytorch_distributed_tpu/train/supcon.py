@@ -8,10 +8,12 @@ Perf notes vs the reference hot loop:
 - augmentation + forward + loss + update is ONE compiled program per step; the
   host only permutes uint8 indices (no worker pool, no PIL, no pinned-memory
   staging);
-- per-step metrics are buffered on device and fetched in one batched transfer
-  every ``print_freq`` steps, keeping XLA's async dispatch pipeline full (the
-  reference's per-iter ``loss.item()`` is a sync point, ``main_supcon.py:320``)
-  while still metering/TB-logging EVERY step at reference cadence;
+- per-step metrics are written into a device-side ring INSIDE the jitted
+  update and flushed as ONE contiguous D2H per ``print_freq`` window on a
+  background telemetry thread (utils/telemetry.py), so the hot loop never
+  blocks on observability (the reference's per-iter ``loss.item()`` is a sync
+  point, ``main_supcon.py:320``) while still metering/TB-logging EVERY step at
+  reference cadence;
 - checkpoint RESUME is supported (``--resume``), which the reference lacks.
 """
 
@@ -37,7 +39,7 @@ from simclr_pytorch_distributed_tpu.ops.augment import (
     two_crop_batch,
 )
 from simclr_pytorch_distributed_tpu.ops import pallas_loss
-from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter, MetricBuffer
+from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
     batch_sharding,
@@ -57,6 +59,7 @@ from simclr_pytorch_distributed_tpu.train.state import (
     realign_schedule_count,
 )
 from simclr_pytorch_distributed_tpu.train.supcon_step import (
+    METRIC_KEYS,
     SupConStepConfig,
     make_train_step,
 )
@@ -77,6 +80,7 @@ from simclr_pytorch_distributed_tpu.utils.guard import (
 )
 from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
 from simclr_pytorch_distributed_tpu.utils.profiling import StepTracer
+from simclr_pytorch_distributed_tpu.utils.telemetry import TelemetrySession
 
 
 def make_augment_config(cfg: config_lib.SupConConfig, color_ops: bool = True) -> AugmentConfig:
@@ -129,12 +133,16 @@ def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1
         dtype=dtype, sync_bn=cfg.syncBN, remat=cfg.remat,
         bn_local_groups=1 if cfg.syncBN else data_parallel,
     )
-    if float(cfg.ngpu) != float(n_devices):
+    # --ngpu auto -> the mesh's data-parallel size; an explicit mismatch is
+    # promoted from a log-only warning to a startup banner naming the
+    # effective-LR consequence (config.ngpu_mismatch_banner)
+    grad_div = config_lib.resolve_ngpu(cfg.ngpu, data_parallel)
+    if grad_div != data_parallel:
         logging.warning(
-            "grad_div=%d (--ngpu) but the mesh has %d devices: gradients are "
-            "divided by %d for recipe fidelity with the reference's %d-GPU "
-            "runs; pass --ngpu %d if you want this mesh's own scaling",
-            cfg.ngpu, n_devices, cfg.ngpu, cfg.ngpu, n_devices,
+            "%s",
+            config_lib.ngpu_mismatch_banner(
+                grad_div, data_parallel, cfg.learning_rate
+            ),
         )
     schedule = make_lr_schedule(
         learning_rate=cfg.learning_rate, epochs=cfg.epochs,
@@ -154,7 +162,7 @@ def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1
         method=cfg.method, temperature=cfg.temp,
         sec=cfg.sec, sec_wei=cfg.sec_wei, l2reg=cfg.l2reg, l2reg_wei=cfg.l2reg_wei,
         norm_momentum=cfg.norm_momentum, epochs=cfg.epochs,
-        steps_per_epoch=steps_per_epoch, grad_div=float(cfg.ngpu),
+        steps_per_epoch=steps_per_epoch, grad_div=float(grad_div),
         loss_impl=resolve_loss_impl(
             cfg.loss_impl, cfg.batch_size, n_devices, cfg.model_parallel
         ),
@@ -162,7 +170,9 @@ def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1
     return model, schedule, tx, state, step_cfg
 
 
-def make_fused_update(model, tx, schedule, step_cfg, aug_cfg, mesh, state_example):
+def make_fused_update(
+    model, tx, schedule, step_cfg, aug_cfg, mesh, state_example, metric_ring=None,
+):
     """augment(two crops) + train step as one GSPMD program.
 
     ``base_key`` is the run's base PRNG key, passed UNCHANGED every step: the
@@ -171,21 +181,47 @@ def make_fused_update(model, tx, schedule, step_cfg, aug_cfg, mesh, state_exampl
     transfer per call — ~5 ms/step on a tunneled chip, where it throttled the
     small probe/CE steps (docs/PERF.md); ``state.step`` equals the driver's
     global step, so the key stream (and therefore training) is bit-identical.
+
+    ``metric_ring`` (an ops/metrics.MetricRing) switches the program to ring
+    telemetry: ``update(state, ring, images, labels, key) -> (state, ring)``
+    with the step's metrics written into row ``state.step % window`` of the
+    donated ring instead of being returned as ~7 live device scalars — the
+    flush then needs ONE contiguous D2H per window (docs/PERF.md zero-sync
+    telemetry). ``None`` keeps the scalar-returning signature (bench.py, the
+    dryrun modes, and the distributed-equivalence tests).
     """
     train_step = make_train_step(model, tx, schedule, step_cfg, mesh=mesh)
-
-    def update(state: TrainState, images_u8, labels, base_key):
-        key = jax.random.fold_in(base_key, state.step)
-        views = two_crop_batch(key, images_u8, aug_cfg)
-        return train_step(state, views, labels)
-
     repl = replicated_sharding(mesh)
     state_sh = state_sharding(mesh, state_example)
+
+    if metric_ring is None:
+        def update(state: TrainState, images_u8, labels, base_key):
+            key = jax.random.fold_in(base_key, state.step)
+            views = two_crop_batch(key, images_u8, aug_cfg)
+            return train_step(state, views, labels)
+
+        return jax.jit(
+            update,
+            in_shardings=(
+                state_sh, batch_sharding(mesh, 4), batch_sharding(mesh, 1), repl,
+            ),
+            out_shardings=(state_sh, repl),
+            donate_argnums=(0,),
+        )
+
+    def ring_update(state: TrainState, ring, images_u8, labels, base_key):
+        key = jax.random.fold_in(base_key, state.step)
+        views = two_crop_batch(key, images_u8, aug_cfg)
+        new_state, metrics = train_step(state, views, labels)
+        return new_state, metric_ring.write(ring, metrics, state.step)
+
     return jax.jit(
-        update,
-        in_shardings=(state_sh, batch_sharding(mesh, 4), batch_sharding(mesh, 1), repl),
+        ring_update,
+        in_shardings=(
+            state_sh, repl, batch_sharding(mesh, 4), batch_sharding(mesh, 1), repl,
+        ),
         out_shardings=(state_sh, repl),
-        donate_argnums=(0,),
+        donate_argnums=(0, 1),
     )
 
 
@@ -196,14 +232,19 @@ TB_ITER_SCALARS = (  # reference per-iter scalars, main_supcon.py:327-333
 
 def train_one_epoch(
     epoch, loader, update_fn, state, mesh, base_key, cfg, tb, steps_per_epoch,
-    tracer=None, start_step=0,
+    tracer=None, start_step=0, telemetry=None,
 ):
     """One epoch (reference train(), main_supcon.py:242-351).
 
-    Metric handling: every step's device metrics are BUFFERED (no fetch, so
-    dispatch stays async) and flushed in one batched D2H transfer at each
-    ``print_freq`` boundary. That keeps the reference's observability
-    semantics — ``info/*`` TB scalars every iteration (main_supcon.py:327-333)
+    Metric handling: the jitted update writes every step's metrics into a
+    device-side ring (``update_fn(state, ring, images, labels, key)``); at
+    each ``print_freq`` boundary the ring is SNAPSHOTTED (device-side copy —
+    later steps donate the ring buffer) and the window job — ONE contiguous
+    D2H, NaN check, meters, TB, the progress log line — runs on the
+    telemetry executor. With ``--telemetry async`` (default) the main thread
+    never blocks on observability; ``sync`` runs the same job inline (the
+    pre-ring semantics). Either way the reference's observability contract
+    holds — ``info/*`` TB scalars every iteration (main_supcon.py:327-333)
     and a loss meter averaging ALL steps (main_supcon.py:320) — without the
     reference's per-iter ``.item()`` sync point.
 
@@ -211,89 +252,115 @@ def train_one_epoch(
     already-consumed prefix of the epoch's deterministic permutation and the
     step indices continue from where the preempted run stopped (``state.step``
     was restored from the checkpoint, so the in-program per-step PRNG keys
-    line up with the uninterrupted run).
+    line up with the uninterrupted run). The ring is transient (never
+    checkpointed); a fresh one is created here each epoch.
 
-    Each flush boundary also checks the preemption flag (utils/preempt.py):
-    metrics are already drained at that point, so on SIGTERM/SIGINT this
-    returns early and :func:`run` writes the emergency mid-epoch checkpoint.
+    Each flush boundary also checks the preemption flag (utils/preempt.py)
+    ON THE MAIN THREAD — the collective decision never depended on the D2H
+    completing; the executor is drained before returning so the emergency
+    checkpoint in :func:`run` sees complete meters. A non-finite loss
+    detected by a background flush re-raises here at the next boundary (at
+    most one window late; docs/RESILIENCE.md).
 
     Returns ``(state, loss_avg, last_metrics, preempted_at)`` where
     ``preempted_at`` is the number of epoch steps completed when preemption
     was observed, or ``None`` for a full epoch.
     """
+    owns_telemetry = telemetry is None
+    if owns_telemetry:
+        telemetry = TelemetrySession(cfg.print_freq, METRIC_KEYS, cfg.telemetry)
     batch_time, data_time, losses = AverageMeter(), AverageMeter(), AverageMeter()
     end = time.time()
-    buffer = MetricBuffer()
-    last_host = {}  # most recently fetched metrics, as python floats
+    last_host = {}  # most recently flushed metrics, as python floats
     bsz = cfg.batch_size
-    window_start = time.time()
+    telemetry.start_window_clock()
+    ring_buf = telemetry.init_buffer(replicated_sharding(mesh))
 
-    def flush():
-        """Fetch all buffered step metrics in one transfer; meter + TB them.
+    def submit_window(boundary_idx, step_hint):
+        """One ``flush_boundary`` (utils/telemetry.py: meter the window on
+        the main thread — same aggregate semantics as the reference's
+        per-iter meter, main_supcon.py:336-337, amortized over print_freq
+        steps — snapshot + queue the one-transfer flush, observe failures
+        collectively). The job NaN-checks, meters, TB-logs every step, and
+        emits the progress line. ``bt`` arrives snapshotted from the main
+        thread (flush_boundary), and ``dt`` is snapshotted here at the
+        boundary: the main thread keeps mutating both meters while the
+        async job runs, so a worker-side read would log a later window's
+        (possibly torn) numbers."""
+        dt = (data_time.val, data_time.avg)
 
-        Batch time is metered per flush window: under async dispatch the
-        per-iteration wall time only measures dispatch (~0), so the real
-        per-step time is (window wall time, INCLUDING this flush's device
-        sync) / steps — same aggregate semantics as the reference's per-iter
-        meter (main_supcon.py:336-337), amortized over print_freq steps.
-        """
-        nonlocal last_host, window_start
-        fetched = buffer.flush()  # device sync happens here
-        for (idx_f, gstep_f), m in fetched:
-            check_finite_loss(m["loss"], gstep_f, cfg.nan_guard)
-            losses.update(m["loss"], bsz)
-            if is_main_process() and tb is not None:
-                # the TRUE global step — same coordinate as the tracer, the
-                # checkpoint meta, and the preemption/rollback log lines, so
-                # a failure event correlates directly against the curves
-                it = (epoch - 1) * steps_per_epoch + idx_f
-                for name in TB_ITER_SCALARS:
-                    tb.log_value(f"info/{name}", m[name], it)
-            last_host = m
-        if fetched:
-            per_step = (time.time() - window_start) / len(fetched)
-            batch_time.update(per_step, n=len(fetched))
-        window_start = time.time()
-
-    for idx, (images_u8, labels) in enumerate(
-        loader.epoch(epoch, start_step=start_step), start=start_step
-    ):
-        data_time.update(time.time() - end)
-        global_step = (epoch - 1) * steps_per_epoch + idx
-        batch = shard_host_batch((images_u8, labels), mesh)
-        # per-step key = fold_in(base_key, state.step) INSIDE the program
-        # (state.step == global_step); see make_fused_update
-        state, metrics = update_fn(state, batch[0], batch[1], base_key)
-        buffer.append((idx, global_step), metrics)
-        if tracer is not None:
-            tracer.step(global_step)
-
-        if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
-            flush()
+        def consume(fetched, bt):
+            for (idx_f, gstep_f), m in fetched:
+                check_finite_loss(m["loss"], gstep_f, cfg.nan_guard)
+                losses.update(m["loss"], bsz)
+                if is_main_process() and tb is not None:
+                    # the TRUE global step — same coordinate as the tracer,
+                    # the checkpoint meta, and the preemption/rollback log
+                    # lines, so a failure event correlates directly against
+                    # the curves
+                    it = (epoch - 1) * steps_per_epoch + idx_f
+                    for name in TB_ITER_SCALARS:
+                        tb.log_value(f"info/{name}", m[name], it)
+                last_host.clear()
+                last_host.update(m)
             logging.info(
                 "Train: [%d][%d/%d]\tBT %.3f (%.3f)\tDT %.3f (%.3f)\t"
                 "loss %.3f (%.3f)\tnorm_mean %.3f (record: %.3f) var %.3f",
-                epoch, idx + 1, steps_per_epoch, batch_time.val, batch_time.avg,
-                data_time.val, data_time.avg, losses.val, losses.avg,
+                epoch, boundary_idx + 1, steps_per_epoch,
+                bt[0], bt[1], dt[0], dt[1], losses.val, losses.avg,
                 last_host["norm_mean"], last_host["record_norm_mean"],
                 last_host["norm_var"],
             )
-            if idx + 1 < steps_per_epoch and preempt.requested_global():
-                # collective decision — every process calls requested_global
-                # at this same deterministic boundary, so all hosts commit
-                # to the same preemption step (a lone-host observation would
-                # deadlock the collective save against peers' train steps).
-                # Metrics are drained (the flush above); hand the mid-epoch
-                # state back so run() can emergency-checkpoint it. The
-                # last-step boundary falls through instead — that preemption
-                # is an ordinary epoch-boundary save.
-                loss_avg = losses.avg if losses.count else last_host.get("loss", 0.0)
-                return state, loss_avg, last_host, idx + 1
-        end = time.time()
 
-    flush()
-    loss_avg = losses.avg if losses.count else last_host.get("loss", 0.0)
-    return state, loss_avg, last_host, None
+        telemetry.flush_boundary(ring_buf, consume, batch_meter=batch_time,
+                                 step_hint=step_hint)
+
+    def epoch_loss_avg():
+        return losses.avg if losses.count else last_host.get("loss", 0.0)
+
+    try:
+        for idx, (images_u8, labels) in enumerate(
+            loader.epoch(epoch, start_step=start_step), start=start_step
+        ):
+            data_time.update(time.time() - end)
+            global_step = (epoch - 1) * steps_per_epoch + idx
+            batch = shard_host_batch((images_u8, labels), mesh)
+            # per-step key = fold_in(base_key, state.step) INSIDE the program
+            # (state.step == global_step); see make_fused_update
+            state, ring_buf = update_fn(state, ring_buf, batch[0], batch[1], base_key)
+            telemetry.append((idx, global_step), global_step)
+            if tracer is not None:
+                tracer.step(global_step)
+
+            if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
+                submit_window(idx, global_step)
+                if idx + 1 < steps_per_epoch and preempt.requested_global():
+                    # collective decision — every process calls
+                    # requested_global at this same deterministic boundary
+                    # (main thread; independent of any in-flight flush), so
+                    # all hosts commit to the same preemption step (a
+                    # lone-host observation would deadlock the collective
+                    # save against peers' train steps). Drain COLLECTIVELY
+                    # (drain_global — a host-local raise here would skip the
+                    # collective emergency save in run() while peers enter
+                    # it) so the meters and that checkpoint see complete
+                    # metrics. The last-step boundary falls through instead —
+                    # that preemption is an ordinary epoch-boundary save.
+                    telemetry.drain_global(global_step)
+                    return state, epoch_loss_avg(), dict(last_host), idx + 1
+            end = time.time()
+
+        # flush any short-epoch tail, then drain COLLECTIVELY — the
+        # epoch-boundary save that follows is collective too (the ordering
+        # contract lives on the session)
+        telemetry.finish_epoch(
+            lambda hint: submit_window(steps_per_epoch - 1, hint),
+            epoch * steps_per_epoch - 1,
+        )
+        return state, epoch_loss_avg(), dict(last_host), None
+    finally:
+        if owns_telemetry:
+            telemetry.close()
 
 
 def enable_compile_cache(compile_cache: str, workdir: str) -> None:
@@ -362,6 +429,10 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         )
 
     aug_cfg = make_augment_config(cfg)
+    # One telemetry session per run: the device-side metric ring (written
+    # inside the jitted update) + the background flush executor the epoch
+    # loop hands each print_freq window to (utils/telemetry.py).
+    telemetry = TelemetrySession(cfg.print_freq, METRIC_KEYS, cfg.telemetry)
 
     def build_update(lr_scale: float):
         """The fused jitted update; ``lr_scale != 1`` (the NaN-rollback
@@ -369,7 +440,8 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         unchanged, so existing opt_states restore into it directly."""
         if lr_scale == 1.0:
             return make_fused_update(
-                model, tx, schedule, step_cfg, aug_cfg, mesh, state
+                model, tx, schedule, step_cfg, aug_cfg, mesh, state,
+                metric_ring=telemetry.ring,
             )
         scaled = lambda s, sc=lr_scale: schedule(s) * sc  # noqa: E731
         return make_fused_update(
@@ -379,6 +451,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
                 weight_decay=cfg.weight_decay, optimizer=cfg.optimizer,
             ),
             scaled, step_cfg, aug_cfg, mesh, state,
+            metric_ring=telemetry.ring,
         )
 
     # failure policy (utils/guard.py): what a NonFiniteLossError does to the
@@ -435,6 +508,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
                 state, loss_avg, metrics, preempted_at = train_one_epoch(
                     epoch, loader, update_fn, state, mesh, base_key, cfg, tb,
                     steps_per_epoch, tracer=tracer, start_step=ss,
+                    telemetry=telemetry,
                 )
             except NonFiniteLossError:
                 # emergency save of the epoch-top state so --resume can
@@ -526,9 +600,12 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         )
     finally:
         # On failure too: stop/flush an active profiler trace (it is most
-        # valuable exactly when the epoch loop died) and drain in-flight
-        # async checkpoint writes so finished payloads get their meta stamp.
+        # valuable exactly when the epoch loop died), stop the telemetry
+        # worker (close never raises — a pending flush error must not mask
+        # the real failure), and drain in-flight async checkpoint writes so
+        # finished payloads get their meta stamp.
         preempt.uninstall()
+        telemetry.close()
         tracer.close()
         tb.close()
         wait_for_saves()

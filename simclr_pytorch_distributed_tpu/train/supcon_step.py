@@ -53,6 +53,17 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
 from simclr_pytorch_distributed_tpu.train.state import TrainState
 
 
+# The step's full metric-dict key set (aux + learning_rate), sorted — the
+# column order of the device-side metric ring (ops/metrics.MetricRing): the
+# jitted writer and the host reader both derive columns from this one tuple,
+# so a metric added to ``train_step`` without extending it fails loudly at
+# trace time instead of silently shifting columns.
+METRIC_KEYS = (
+    "learning_rate", "loss", "loss_l2reg", "loss_sec",
+    "norm_mean", "norm_var", "record_norm_mean",
+)
+
+
 @dataclasses.dataclass(frozen=True)
 class SupConStepConfig:
     """Static step configuration (mirrors the reference argparse flags)."""
@@ -250,6 +261,7 @@ def make_train_step(
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = dict(aux, learning_rate=jnp.asarray(schedule(state.step)))
+        assert tuple(sorted(metrics)) == METRIC_KEYS, sorted(metrics)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,

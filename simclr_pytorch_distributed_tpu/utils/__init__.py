@@ -12,6 +12,11 @@ from simclr_pytorch_distributed_tpu.utils.guard import (  # noqa: F401
     check_finite_loss,
 )
 from simclr_pytorch_distributed_tpu.utils.profiling import StepTracer  # noqa: F401
+from simclr_pytorch_distributed_tpu.utils.telemetry import (  # noqa: F401
+    FlushExecutor,
+    TelemetryFlushError,
+    TelemetrySession,
+)
 from simclr_pytorch_distributed_tpu.utils.logging_utils import (  # noqa: F401
     TBLogger,
     setup_logging,

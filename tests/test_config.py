@@ -131,19 +131,29 @@ SUPCON_FLAG_DELTAS = {
     "local_rank",
 }
 LINEAR_FLAG_DELTAS: set = set()
+# flags whose TYPE is a documented superset of the reference's (the parsed
+# value for every reference-legal input must still match):
+SUPCON_TYPE_DELTAS = {
+    # reference type=int; ours also accepts 'auto' (mesh-resolved grad_div,
+    # config.ngpu_arg) — integer inputs parse identically (asserted below)
+    "ngpu",
+}
+LINEAR_TYPE_DELTAS: set = set()
 
 
 @pytest.mark.skipif(
     not os.path.isdir(REFERENCE_DIR), reason="reference checkout not present"
 )
 @pytest.mark.parametrize(
-    "rel_path,ours,deltas,min_flags",
+    "rel_path,ours,deltas,type_deltas,min_flags",
     [
-        ("main_supcon.py", supcon_parser, SUPCON_FLAG_DELTAS, 30),
-        ("main_linear.py", lambda: linear_parser(ce=False), LINEAR_FLAG_DELTAS, 15),
+        ("main_supcon.py", supcon_parser, SUPCON_FLAG_DELTAS,
+         SUPCON_TYPE_DELTAS, 30),
+        ("main_linear.py", lambda: linear_parser(ce=False), LINEAR_FLAG_DELTAS,
+         LINEAR_TYPE_DELTAS, 15),
     ],
 )
-def test_flag_surface_covers_reference(rel_path, ours, deltas, min_flags):
+def test_flag_surface_covers_reference(rel_path, ours, deltas, type_deltas, min_flags):
     """EVERY flag the reference's argparse registers exists here with the
     same default (and at least the same choices), modulo the documented
     deltas — so a round-N edit cannot silently drift the schema."""
@@ -169,9 +179,13 @@ def test_flag_surface_covers_reference(rel_path, ours, deltas, min_flags):
         if isinstance(ref, argparse._StoreTrueAction):
             assert isinstance(mine, argparse._StoreTrueAction), f"--{name}"
         elif ref.type is not None:
-            assert mine.type is ref.type, (
-                f"--{name}: type {mine.type} != reference {ref.type}"
-            )
+            if name in type_deltas:
+                # documented superset: reference-legal inputs parse the same
+                assert mine.type(str(ref.type("3"))) == 3, f"--{name}"
+            else:
+                assert mine.type is ref.type, (
+                    f"--{name}: type {mine.type} != reference {ref.type}"
+                )
 
 
 def test_ce_syncbn_flag(tmp_path):
@@ -185,6 +199,79 @@ def test_ce_syncbn_flag(tmp_path):
         "--workdir", str(tmp_path)], ce=True).syncBN
     with pytest.raises(SystemExit):
         parse_linear(["--syncBN", "--workdir", str(tmp_path)], ce=False)
+
+
+def test_ngpu_auto_resolves_to_data_parallel(tmp_path):
+    """--ngpu auto -> the mesh's data-parallel size at build time; explicit
+    integers pass through (incl. int-like strings from restored configs)."""
+    from simclr_pytorch_distributed_tpu.config import ngpu_arg, resolve_ngpu
+
+    cfg = parse_supcon(["--ngpu", "auto", "--workdir", str(tmp_path)])
+    assert cfg.ngpu == "auto"
+    assert resolve_ngpu(cfg.ngpu, data_parallel=8) == 8
+    assert resolve_ngpu(cfg.ngpu, data_parallel=1) == 1
+    assert resolve_ngpu(2, data_parallel=8) == 2
+    assert resolve_ngpu("4", data_parallel=8) == 4  # restored config dict
+    assert ngpu_arg("AUTO") == "auto" and ngpu_arg("2") == 2
+    with pytest.raises(argparse.ArgumentTypeError):
+        ngpu_arg("two")
+    # it becomes the gradient divisor: 0/negative must die at parse, not
+    # as a ZeroDivisionError mid-startup (or a sign-flipped update)
+    for bad in ("0", "-2"):
+        with pytest.raises(argparse.ArgumentTypeError, match="positive"):
+            ngpu_arg(bad)
+    with pytest.raises(ValueError, match="positive"):
+        resolve_ngpu(0, data_parallel=4)
+    import json
+
+    json.dumps(config_dict(cfg))  # 'auto' stays JSON-safe in checkpoint meta
+
+
+def test_ngpu_auto_and_banner_in_build(tmp_path, caplog):
+    """build() with --ngpu auto emits NO banner; an explicit mismatch emits
+    the startup banner naming the effective-LR consequence."""
+    import logging
+
+    from simclr_pytorch_distributed_tpu.config import ngpu_mismatch_banner
+    from simclr_pytorch_distributed_tpu.train.supcon import build
+
+    auto_cfg = parse_supcon(
+        ["--ngpu", "auto", "--model", "resnet10", "--dataset", "synthetic",
+         "--workdir", str(tmp_path)]
+    )
+    with caplog.at_level(logging.WARNING):
+        _, _, _, _, step_cfg = build(auto_cfg, steps_per_epoch=10, n_devices=4)
+    assert step_cfg.grad_div == 4.0  # mesh-resolved
+    assert "--ngpu" not in caplog.text
+
+    caplog.clear()
+    mism_cfg = parse_supcon(
+        ["--ngpu", "2", "--model", "resnet10", "--dataset", "synthetic",
+         "--workdir", str(tmp_path)]
+    )
+    with caplog.at_level(logging.WARNING):
+        _, _, _, _, step_cfg = build(mism_cfg, steps_per_epoch=10, n_devices=4)
+    assert step_cfg.grad_div == 2.0  # recipe fidelity preserved
+    assert "EFFECTIVE learning rate" in caplog.text
+    assert "--ngpu auto" in caplog.text
+
+    banner = ngpu_mismatch_banner(2, 4, 0.5)
+    assert "4/2" in banner and "~1" in banner  # 0.5 * 4/2 = 1.0
+
+
+def test_telemetry_flag_both_parsers(tmp_path):
+    """--telemetry {async,sync} on all three trainers' parsers; async is the
+    default (the zero-sync hot loop)."""
+    assert parse_supcon(["--workdir", str(tmp_path)]).telemetry == "async"
+    assert parse_supcon(
+        ["--telemetry", "sync", "--workdir", str(tmp_path)]
+    ).telemetry == "sync"
+    assert parse_linear(["--workdir", str(tmp_path)]).telemetry == "async"
+    assert parse_linear(
+        ["--telemetry", "sync", "--workdir", str(tmp_path)], ce=True
+    ).telemetry == "sync"
+    with pytest.raises(SystemExit):
+        parse_supcon(["--telemetry", "never", "--workdir", str(tmp_path)])
 
 
 def test_linear_parser_accepts_resume_for_launcher_contract():

@@ -11,7 +11,9 @@ import pytest
 from simclr_pytorch_distributed_tpu import config as config_lib
 from simclr_pytorch_distributed_tpu.parallel.mesh import create_mesh
 from simclr_pytorch_distributed_tpu.train.supcon import train_one_epoch
+from simclr_pytorch_distributed_tpu.train.supcon_step import METRIC_KEYS
 from simclr_pytorch_distributed_tpu.utils import preempt
+from simclr_pytorch_distributed_tpu.utils.telemetry import TelemetrySession
 from simclr_pytorch_distributed_tpu.utils.guard import (
     MAX_ROLLBACKS,
     ROLLBACK_LR_MULT,
@@ -40,40 +42,59 @@ class _FakeLoader:
             yield images, labels
 
 
+def _ring_fake_update(session, metrics):
+    """A fake ring-mode update: writes ``metrics`` into the ring at a
+    self-tracked step counter (epoch 1 -> step == idx), like the jitted
+    update writes at ``state.step % window``."""
+    calls = []
+
+    def fake_update(state, ring, images, labels, key):
+        calls.append(1)
+        return state, session.ring.write(
+            ring, metrics, jnp.int32(len(calls) - 1)
+        )
+
+    return fake_update, calls
+
+
 def test_epoch_loop_raises_on_nan(monkeypatch):
     cfg = config_lib.SupConConfig(print_freq=1, batch_size=8, nan_guard=True)
     mesh = create_mesh(devices=jax.devices()[:1])
-    metrics = {
-        "loss": jnp.float32(float("nan")), "norm_mean": jnp.float32(0),
-        "norm_var": jnp.float32(0), "record_norm_mean": jnp.float32(0),
-        "loss_sec": jnp.float32(0), "loss_l2reg": jnp.float32(0),
-    }
+    metrics = dict.fromkeys(METRIC_KEYS, jnp.float32(0))
+    metrics["loss"] = jnp.float32(float("nan"))
 
-    def fake_update(state, images, labels, key):
-        return state, metrics
-
-    with pytest.raises(NonFiniteLossError):
-        train_one_epoch(
-            1, _FakeLoader(3, 8), fake_update, state=None, mesh=mesh,
-            base_key=jax.random.key(0), cfg=cfg, tb=None, steps_per_epoch=3,
-        )
+    session = TelemetrySession(cfg.print_freq, METRIC_KEYS, cfg.telemetry)
+    fake_update, _ = _ring_fake_update(session, metrics)
+    try:
+        with pytest.raises(NonFiniteLossError):
+            train_one_epoch(
+                1, _FakeLoader(3, 8), fake_update, state=None, mesh=mesh,
+                base_key=jax.random.key(0), cfg=cfg, tb=None, steps_per_epoch=3,
+                telemetry=session,
+            )
+    finally:
+        session.close()
 
     # guard off: the same epoch completes and reports the NaN average
     cfg_off = config_lib.SupConConfig(print_freq=1, batch_size=8, nan_guard=False)
-    _, loss_avg, _, preempted_at = train_one_epoch(
-        1, _FakeLoader(3, 8), fake_update, state=None, mesh=mesh,
-        base_key=jax.random.key(0), cfg=cfg_off, tb=None, steps_per_epoch=3,
-    )
+    session_off = TelemetrySession(cfg_off.print_freq, METRIC_KEYS, cfg_off.telemetry)
+    fake_update, _ = _ring_fake_update(session_off, metrics)
+    try:
+        _, loss_avg, _, preempted_at = train_one_epoch(
+            1, _FakeLoader(3, 8), fake_update, state=None, mesh=mesh,
+            base_key=jax.random.key(0), cfg=cfg_off, tb=None, steps_per_epoch=3,
+            telemetry=session_off,
+        )
+    finally:
+        session_off.close()
     assert math.isnan(loss_avg)
     assert preempted_at is None
 
 
 def _finite_metrics():
-    return {
-        "loss": jnp.float32(1.0), "norm_mean": jnp.float32(0),
-        "norm_var": jnp.float32(0), "record_norm_mean": jnp.float32(0),
-        "loss_sec": jnp.float32(0), "loss_l2reg": jnp.float32(0),
-    }
+    m = dict.fromkeys(METRIC_KEYS, jnp.float32(0))
+    m["loss"] = jnp.float32(1.0)
+    return m
 
 
 def test_epoch_loop_observes_preemption_at_flush_boundary():
@@ -82,23 +103,24 @@ def test_epoch_loop_observes_preemption_at_flush_boundary():
     driver can stamp step_in_epoch into the emergency save."""
     cfg = config_lib.SupConConfig(print_freq=2, batch_size=8)
     mesh = create_mesh(devices=jax.devices()[:1])
-    metrics = _finite_metrics()
+    session = TelemetrySession(cfg.print_freq, METRIC_KEYS, cfg.telemetry)
+    fake_update, calls = _ring_fake_update(session, _finite_metrics())
 
-    calls = []
-
-    def fake_update(state, images, labels, key):
-        calls.append(1)
+    def preempting_update(state, ring, images, labels, key):
+        state, ring = fake_update(state, ring, images, labels, key)
         if len(calls) == 1:
             preempt.request()  # signal lands during step 1's window
-        return state, metrics
+        return state, ring
 
     try:
         state, loss_avg, _, preempted_at = train_one_epoch(
-            1, _FakeLoader(8, 8), fake_update, state=None, mesh=mesh,
+            1, _FakeLoader(8, 8), preempting_update, state=None, mesh=mesh,
             base_key=jax.random.key(0), cfg=cfg, tb=None, steps_per_epoch=8,
+            telemetry=session,
         )
     finally:
         preempt.uninstall()
+        session.close()
     assert preempted_at == 2  # observed at the first flush (print_freq=2)
     assert len(calls) == 2  # no further steps dispatched
     assert loss_avg == 1.0
@@ -109,21 +131,24 @@ def test_epoch_loop_last_step_preemption_falls_through():
     the epoch-boundary path in run() handles it (no mid-epoch marker)."""
     cfg = config_lib.SupConConfig(print_freq=10, batch_size=8)
     mesh = create_mesh(devices=jax.devices()[:1])
-    metrics = _finite_metrics()
+    session = TelemetrySession(cfg.print_freq, METRIC_KEYS, cfg.telemetry)
+    fake_update, _ = _ring_fake_update(session, _finite_metrics())
 
-    def fake_update(state, images, labels, key):
+    def preempting_update(state, ring, images, labels, key):
         preempt.request()
-        return state, metrics
+        return fake_update(state, ring, images, labels, key)
 
     try:
         _, _, _, preempted_at = train_one_epoch(
-            1, _FakeLoader(3, 8), fake_update, state=None, mesh=mesh,
+            1, _FakeLoader(3, 8), preempting_update, state=None, mesh=mesh,
             base_key=jax.random.key(0), cfg=cfg, tb=None, steps_per_epoch=3,
+            telemetry=session,
         )
         assert preempted_at is None
         assert preempt.requested()  # still pending for run()'s boundary check
     finally:
         preempt.uninstall()
+        session.close()
 
 
 def test_failure_policy_abort_never_rolls_back():

@@ -391,3 +391,149 @@ def test_xplane_parse_breakdown_wire_decode():
     # an unknown field tag after the repeated block stops the scan cleanly
     got2 = xplane_bw._parse_breakdown(raw + b"\x12\x00", MA)
     assert [g.bytes_accessed for g in got2] == [12345, 2**40]
+
+
+# ----------------------------------------------------------------- flush_ab
+
+
+def test_flush_ab_build_output_schema():
+    """The committed docs/evidence/flush_ab_r6.json schema, pinned without
+    running the measurement (the h2d_overlap_ab pattern)."""
+    flush_ab = _load("flush_ab")
+    rounds = [
+        {"sync": [12.0, 11.8], "async": [7.1, 7.0]},
+        {"sync": [12.4, 12.2], "async": [7.3, 6.9]},
+    ]
+    out = flush_ab.build_output("cpu", 60.0, 10, 3, rounds)
+    assert out["metric"] == "flush_ab_ms_per_step"
+    assert out["runs"] == rounds
+    assert out["delay_ms"] == 60.0 and out["window"] == 10
+    s = out["summary"]
+    assert s["sync_ms_per_step"] == 12.1  # median of 4 sync measurements
+    assert s["async_ms_per_step"] == 7.05
+    assert s["stall_removed_ms_per_window"] == round((12.1 - 7.05) * 10, 1)
+    assert s["speedup"] == round(12.1 / 7.05, 3)
+    assert "ABBA" in out["arm_order"]
+
+
+@pytest.mark.slow
+def test_flush_ab_smoke_async_removes_stall(tmp_path):
+    """End-to-end CPU proxy: with an injected per-flush transfer delay the
+    async arm must be strictly faster per step than the sync arm (the whole
+    point of the background executor) — same compiled update both arms."""
+    flush_ab = _load("flush_ab")
+    out_path = tmp_path / "flush_ab.json"
+    out = flush_ab.main(["--smoke", "--rounds", "1", "--json", str(out_path)])
+    s = out["summary"]
+    # the sync arm pays delay_ms per window on the dispatch thread; the
+    # async arm amortizes one drain-tail delay per arm. Require at least
+    # half the injected stall to vanish (generous vs timer noise).
+    assert s["async_ms_per_step"] < s["sync_ms_per_step"]
+    assert s["stall_removed_ms_per_window"] > out["delay_ms"] / 2
+    assert json.loads(out_path.read_text())["metric"] == "flush_ab_ms_per_step"
+
+
+# ------------------------------------------------------- ratchet bench gate
+
+
+def test_ratchet_parse_bench_json_takes_last_metric_line(tmp_path):
+    ratchet = _load("ratchet")
+    log = tmp_path / "bench.log"
+    log.write_text(
+        "warmup noise\n"
+        '{"run": 0, "variant": "x"}\n'
+        '{"metric": "pretrain_imgs_per_sec_per_chip", "value": 100.0}\n'
+        "not json {\n"
+        '{"metric": "pretrain_imgs_per_sec_per_chip", "value": 4100.2, '
+        '"vs_baseline": 1.0083}\n'
+    )
+    rec = ratchet.parse_bench_json(str(log))
+    assert rec["value"] == 4100.2 and rec["vs_baseline"] == 1.0083
+
+    (tmp_path / "empty.log").write_text("nothing\n")
+    with pytest.raises(ratchet.ConfigFailed):
+        ratchet.parse_bench_json(str(tmp_path / "empty.log"))
+
+
+def test_ratchet_bench_gate_bar_and_config():
+    """The perf bar (VERDICT #6) rides the default config list and its bar
+    is 95% of the RECORDED repo baseline — bench.py and ratchet.py must
+    agree on the number (single source of truth in bench.REPO_BASELINES)."""
+    ratchet = _load("ratchet")
+    import bench
+
+    assert "bench_pretrain" in ratchet.CONFIGS
+    spec = ratchet.CONFIGS["bench_pretrain"]
+    assert spec["kind"] == "bench"
+    # ONE series name for success and ConfigFailed records alike
+    assert ratchet.bench_metric_name(spec) == (
+        "ratchet_bench_pretrain_imgs_per_sec_per_chip"
+    )
+    assert bench.REPO_BASELINES["pretrain"] == 4066.5  # BENCH_r05 headline
+    assert ratchet._bench_bar() == round(0.95 * 4066.5, 1)
+    # vs_baseline now reads the recorded baseline, not the hardcoded 1.0
+    assert bench.vs_baseline_for("pretrain", 4066.5) == 1.0
+    assert bench.vs_baseline_for("pretrain", 2033.25) == 0.5
+    assert bench.vs_baseline_for("linear", 999.0) == 1.0  # no record yet
+
+
+def test_ratchet_bench_gate_decision():
+    """The gate only enforces the chip-specific bar ON the baseline chip;
+    elsewhere it pass-skips with the reason on record. On the baseline chip
+    a clock_suspect run fails even above the bar — an inflated number must
+    not mask a regression."""
+    ratchet = _load("ratchet")
+    import bench
+
+    spec = ratchet.CONFIGS["bench_pretrain"]
+    kind = bench.REPO_BASELINE_DEVICE_KIND
+
+    def rec(value, device_kind, clock_suspect=False, chips=1):
+        return {"value": value, "vs_baseline": 1.0,
+                "detail": {"device_kind": device_kind, "chips": chips,
+                           "clock_suspect": clock_suspect}}
+
+    bar = 3863.2
+    r = ratchet.bench_gate_record(spec, rec(4000.0, kind), bar)
+    assert r["ok"] and "skipped" not in r
+    r = ratchet.bench_gate_record(spec, rec(3000.0, kind), bar)
+    assert not r["ok"]
+    # above the bar but the clock is suspect: fail, never certify
+    r = ratchet.bench_gate_record(spec, rec(6000.0, kind, clock_suspect=True),
+                                  bar)
+    assert not r["ok"] and "clock_suspect" in r["error"]
+    # a different accelerator: the v5-lite bar is not comparable — pass-skip
+    r = ratchet.bench_gate_record(spec, rec(100.0, "TPU v4"), bar)
+    assert r["ok"] and "not comparable" in r["skipped"]
+    # same kind but multi-chip: the 1-chip baseline's per-chip workload is
+    # 256 imgs/chip; a sharded 32/chip run sits below the bar with no real
+    # regression (bench_perchip32_r5.json: 3294.5) — pass-skip, never fail
+    r = ratchet.bench_gate_record(spec, rec(3294.5, kind, chips=8), bar)
+    assert r["ok"] and "not comparable" in r["skipped"]
+
+
+# ------------------------------------------------------------------ hygiene
+
+
+def test_no_binaries_or_pycache_tracked():
+    """VERDICT #7: the compiled .so (and any __pycache__/.pyc) must never be
+    committed — native/build.py compiles on demand."""
+    import subprocess
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    if not os.path.isdir(os.path.join(repo, ".git")):
+        pytest.skip("not a git checkout")
+    try:
+        tracked = subprocess.run(
+            ["git", "ls-files"], cwd=repo, capture_output=True, text=True,
+            timeout=60, check=True,
+        ).stdout.splitlines()
+    except (OSError, subprocess.SubprocessError):
+        pytest.skip("git unavailable")
+    offenders = [
+        f for f in tracked
+        if f.endswith((".so", ".pyc")) or "__pycache__" in f
+    ]
+    assert not offenders, offenders
+    gitignore = open(os.path.join(repo, ".gitignore")).read()
+    assert "*.so" in gitignore and "__pycache__/" in gitignore
