@@ -101,8 +101,19 @@ def _compile_with_flops(update, *example_args):
         return update, 0.0, 0.0
 
 
-def _setup_pretrain(mesh, batch, size, stem):
-    """The headline workload: fused SimCLR pretrain step (recipe config)."""
+def _setup_pretrain(mesh, batch, size, stem, data_placement="host"):
+    """The headline workload: fused SimCLR pretrain step (recipe config).
+
+    ``data_placement='device'`` benches the resident-store step instead
+    (data/device_store.py): the jitted update takes the full-epoch
+    ``[steps, batch, ...]`` buffers and slices its own batch at
+    ``state.step % steps_per_epoch`` — the same program the drivers run
+    under ``--data_placement device``, so the slice's cost (if any) is
+    measured with the existing methodology. Note bench's 'host' arm is
+    already transfer-free (the same example batch every step — the
+    resident-batch FLOOR); this arm isolates the in-program slice, while
+    ``scripts/resident_ab.py`` measures the driver-loop transfer removal.
+    """
     from simclr_pytorch_distributed_tpu.models import SupConResNet
     from simclr_pytorch_distributed_tpu.ops.augment import AugmentConfig
     from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
@@ -136,17 +147,36 @@ def _setup_pretrain(mesh, batch, size, stem):
         steps_per_epoch=steps_per_epoch, grad_div=2.0, loss_impl=loss_impl,
     )
     update = make_fused_update(
-        model, tx, schedule, step_cfg, AugmentConfig(size=size), mesh, state
+        model, tx, schedule, step_cfg, AugmentConfig(size=size), mesh, state,
+        resident=data_placement == "device",
     )
 
     rng = np.random.default_rng(0)
-    images = rng.integers(0, 256, size=(batch, size, size, 3), dtype=np.uint8)
-    labels = rng.integers(0, 10, size=(batch,)).astype(np.int32)
-    sh_images, sh_labels = shard_host_batch((images, labels), mesh)
+    if data_placement == "device":
+        # the drivers' resident layout: one full shuffled epoch on device,
+        # batch dim sharded (parallel/mesh.epoch_buffer_sharding)
+        from simclr_pytorch_distributed_tpu.parallel.mesh import (
+            epoch_buffer_sharding,
+        )
+
+        images = rng.integers(
+            0, 256, size=(steps_per_epoch, batch, size, size, 3),
+            dtype=np.uint8,
+        )
+        labels = rng.integers(
+            0, 10, size=(steps_per_epoch, batch)
+        ).astype(np.int32)
+        sh_images = jax.device_put(images, epoch_buffer_sharding(mesh, 5))
+        sh_labels = jax.device_put(labels, epoch_buffer_sharding(mesh, 2))
+    else:
+        images = rng.integers(0, 256, size=(batch, size, size, 3), dtype=np.uint8)
+        labels = rng.integers(0, 10, size=(batch,)).astype(np.int32)
+        sh_images, sh_labels = shard_host_batch((images, labels), mesh)
 
     config = (
         f"SimCLR rn50 cifar-recipe bf16 fused-aug bsz{batch} loss={loss_impl}"
         + ("" if stem == "conv" else f" stem={stem}")
+        + ("" if data_placement == "host" else f" data={data_placement}")
     )
     return update, sh_images, sh_labels, state, "pretrain", config
 
@@ -256,9 +286,17 @@ def main(argv=None):
              "256, the per-device workload for the multi-chip projection in "
              "docs/PERF.md)",
     )
+    ap.add_argument(
+        "--data_placement", choices=["host", "device"], default="host",
+        help="device = bench the resident-store step (full-epoch HBM buffer "
+             "+ in-program slice, the --data_placement device driver "
+             "program) with the same methodology",
+    )
     args = ap.parse_args(argv)
     if args.stem != "conv" and args.stage != "pretrain":
         ap.error("--stem applies to --stage pretrain only")
+    if args.data_placement != "host" and args.stage != "pretrain":
+        ap.error("--data_placement applies to --stage pretrain only")
 
     from simclr_pytorch_distributed_tpu.parallel.mesh import create_mesh
 
@@ -269,7 +307,9 @@ def main(argv=None):
     batch, size = args.batch_size, 32
 
     if args.stage == "pretrain":
-        setup = _setup_pretrain(mesh, batch, size, args.stem)
+        setup = _setup_pretrain(
+            mesh, batch, size, args.stem, data_placement=args.data_placement
+        )
     elif args.stage == "linear":
         setup = _setup_linear(mesh, batch, size)
     else:
@@ -359,6 +399,7 @@ def main(argv=None):
         "vs_baseline": (
             vs_baseline_for(metric_stage, per_chip)
             if args.batch_size == 256 and args.stem == "conv"
+            and args.data_placement == "host"
             and n_chips == 1 and device_kind == REPO_BASELINE_DEVICE_KIND
             else 1.0
         ),

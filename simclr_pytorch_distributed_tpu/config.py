@@ -119,6 +119,13 @@ class SupConConfig:
     # detection at most one print_freq window late — utils/telemetry.py);
     # 'sync' = inline on the dispatch thread (the pre-ring semantics)
     telemetry: str = "async"
+    # where training batches live (data/device_store.py): 'device' keeps the
+    # uint8 dataset HBM-resident (one index upload + compiled shuffle-gather
+    # per epoch; the hot loop is dispatch-only — no per-step H2D); 'host' is
+    # the per-step device_put loop; 'auto' picks 'device' when the dataset is
+    # a plain in-RAM array within the HBM budget, else falls back to 'host'
+    # with a startup banner. Batch composition is bit-identical either way.
+    data_placement: str = "auto"
     # derived (finalize_supcon)
     warm_epochs: int = 10
     warmup_from: float = 0.01
@@ -267,7 +274,32 @@ def supcon_parser() -> argparse.ArgumentParser:
                    choices=["async", "sync"],
                    help="metric flush: background thread (zero sync on the "
                         "hot loop; NaN detection <=1 window late) or inline")
+    p.add_argument("--data_placement", type=str, default=d.data_placement,
+                   choices=["host", "device", "auto"],
+                   help="training batches: 'device' = HBM-resident epoch "
+                        "buffer, dispatch-only hot loop; 'auto' falls back "
+                        "to 'host' (per-step H2D) for memmap-backed or "
+                        "over-budget datasets")
     return p
+
+
+def validate_data_placement(dataset: str, data_placement: str) -> None:
+    """Parse-time check of --data_placement interactions.
+
+    ``path`` trees can decode into an on-disk memmap (data/folder.py above
+    ``--mmap_threshold_mb``), which device residency refuses — whether THIS
+    tree does is only known after the decode, so an explicit ``device``
+    request is rejected up front rather than failing deep in setup; ``auto``
+    resolves against the decoded array (and falls back with a banner).
+    """
+    if data_placement == "device" and dataset == "path":
+        raise ValueError(
+            "--data_placement device is not accepted with --dataset path: "
+            "folder datasets may decode to an on-disk memmap "
+            "(--mmap_threshold_mb), which cannot be made device-resident — "
+            "use --data_placement auto (decides from the decoded size, "
+            "falls back to host with a banner) or host"
+        )
 
 
 def parse_supcon(argv=None) -> SupConConfig:
@@ -280,6 +312,7 @@ def parse_supcon(argv=None) -> SupConConfig:
 
 def finalize_supcon(cfg: SupConConfig, make_dirs: bool = True) -> SupConConfig:
     """Derived fields, replicating main_supcon.py:92-150."""
+    validate_data_placement(cfg.dataset, cfg.data_placement)
     if cfg.dataset == "path":
         assert cfg.data_folder is not None and cfg.mean is not None and cfg.std is not None
     if cfg.data_folder is None:
@@ -352,6 +385,7 @@ class LinearConfig:
     trial: str = "0"
     compile_cache: str = "auto"  # same semantics as the pretrain flag
     telemetry: str = "async"  # same semantics as the pretrain flag
+    data_placement: str = "auto"  # same semantics as the pretrain flag
     # derived
     n_cls: int = 10
     warm_epochs: int = 10
@@ -404,6 +438,11 @@ def linear_parser(ce: bool = False) -> argparse.ArgumentParser:
     p.add_argument("--telemetry", type=str, default=d.telemetry,
                    choices=["async", "sync"],
                    help="metric flush: background thread or inline")
+    p.add_argument("--data_placement", type=str, default=d.data_placement,
+                   choices=["host", "device", "auto"],
+                   help="training batches: HBM-resident epoch buffer "
+                        "('device'), per-step H2D ('host'), or decide from "
+                        "the dataset size ('auto')")
     return p
 
 

@@ -1,0 +1,356 @@
+"""Device-resident data placement: the HBM-resident epoch buffer.
+
+``docs/PERF.md`` round-5 measured the last unfixed gap between the production
+driver loop and the pure compiled step: the per-step uint8 H2D transfer
+(``shard_host_batch`` -> ``device_put``) costs a volatile 0-10 ms/step on the
+tunneled link, while a device-resident batch sits at a stable 64.6-65.2
+ms/step floor (``docs/evidence/h2d_overlap_ab_r5.json``). For datasets that
+fit an HBM budget (CIFAR-10/100 train is ~150 MB uint8), this module removes
+the per-step transfer entirely:
+
+- the full uint8 dataset is uploaded ONCE at startup, replicated per device
+  (replication is what keeps the per-epoch shuffle gather collective-free:
+  every device gathers its own rows from its own full copy; the cost is
+  bounded and pre-checked against the budget);
+- per epoch the host computes the SAME numpy permutation ``EpochLoader``
+  already uses (``data/pipeline.py`` ``_epoch_order`` — this class holds the
+  loader and calls it, so there is exactly one permutation source) and ships
+  only the int32 index matrix (~200 KB for CIFAR: ONE transfer per epoch,
+  asserted mechanically via the injectable ``index_put`` hook);
+- one compiled program gathers the permuted epoch into a ``[steps, batch,
+  ...]`` buffer sharded batch-wise over the mesh's ``data`` axis (each
+  process's devices hold only that process's slice of every global batch —
+  the multi-host layout of ``EpochLoader``'s per-process slicing); the
+  per-epoch gather is the ONLY row gather, so the TPU gather-lowering trap
+  (the 227x crop lesson, docs/PERF.md) never applies per-step;
+- each train step slices its batch with a contiguous leading-axis
+  ``lax.dynamic_slice`` at ``state.step % steps_per_epoch``
+  (:func:`slice_epoch_step`; the buffer is a NON-donated jit argument), so
+  the hot loop is dispatch-only: no host work, no transfer, no sync.
+
+Batch composition is bit-identical to the host loader by construction (same
+permutation, same drop_last truncation, same per-process slicing), so
+accuracy ratchets carry over; mid-epoch resume is a slice-offset shift
+(``state.step`` restores from the checkpoint and the in-program position
+follows). Proven byte-for-byte by ``tests/test_device_store.py``.
+
+``resolve_data_placement`` implements the ``--data_placement`` contract:
+``auto`` degrades gracefully to host placement (one startup banner naming
+the reason) when the dataset is memmap-backed (``data/folder.py`` trees —
+resident placement would silently page the whole memmap into RAM) or
+exceeds the HBM budget; it never OOMs.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from simclr_pytorch_distributed_tpu.parallel.mesh import (
+    DATA_AXIS,
+    epoch_buffer_sharding,
+    replicated_sharding,
+)
+
+logger = logging.getLogger(__name__)
+
+# Budget used when the backend reports no memory stats (CPU, some drivers):
+# conservative vs any real accelerator HBM, far above CIFAR-scale data.
+DEFAULT_BUDGET_BYTES = 4 << 30
+# Fraction of the reported free per-device memory the store may claim — the
+# model, optimizer state, activations, and the XLA allocator's slack own the
+# rest. Deliberately conservative: 'auto' must degrade, never OOM.
+BUDGET_FRACTION = 0.4
+
+
+def dataset_nbytes(images: np.ndarray, labels: np.ndarray) -> int:
+    return int(images.nbytes) + int(np.asarray(labels).nbytes)
+
+
+def _is_memmap_backed(arr) -> bool:
+    """True if ``arr`` is an ``np.memmap`` or a view over one.
+
+    Wrappers strip the subclass without copying: ``np.ascontiguousarray`` on
+    a C-contiguous memmap (``EpochLoader.__init__``) returns a plain
+    ``ndarray`` VIEW whose ``base`` chain still ends at the on-disk file —
+    a bare ``isinstance`` check would wave it through and residency would
+    silently page the whole tree into RAM/HBM.
+    """
+    while arr is not None:
+        if isinstance(arr, np.memmap):
+            return True
+        arr = getattr(arr, "base", None)
+    return False
+
+
+def device_budget_bytes(fraction: float = BUDGET_FRACTION) -> int:
+    """Per-device placement budget: ``fraction`` of free device memory.
+
+    ``memory_stats()`` is backend-dependent (absent on CPU and some
+    platforms); without it the budget falls back to a fixed conservative
+    default rather than guessing at hardware.
+    """
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:  # noqa: BLE001 — backend-dependent API
+        stats = {}
+    limit = stats.get("bytes_limit")
+    if not limit:
+        return DEFAULT_BUDGET_BYTES
+    free = int(limit) - int(stats.get("bytes_in_use", 0))
+    return max(0, int(free * fraction))
+
+
+def resident_bytes_per_device(
+    images: np.ndarray, labels: np.ndarray, global_batch_size: int,
+    data_parallel: int,
+) -> int:
+    """Per-device HBM the store will claim: the replicated dataset plus the
+    double-buffered epoch buffer shard.
+
+    The epoch buffer holds the drop_last-truncated epoch
+    (``steps * global_batch`` rows) sharded ``data_parallel`` ways; 2x
+    covers the transient overlap while epoch e+1's gather output coexists
+    with epoch e's buffer (and matches the ISSUE's stated bound).
+    """
+    n = len(images)
+    used_rows = (n // global_batch_size) * global_batch_size
+    row_bytes = (
+        int(images.nbytes // max(1, n))
+        + int(np.asarray(labels).nbytes // max(1, n))
+    )
+    buffer_shard = -(-used_rows * row_bytes // max(1, data_parallel))  # ceil
+    return dataset_nbytes(images, labels) + 2 * buffer_shard
+
+
+def _agree_across_processes(local_ok: bool) -> bool:
+    """Collective AND of the per-process placement verdicts.
+
+    The budget reads LOCAL ``memory_stats``, which can differ across hosts
+    (fragmentation, co-resident allocations) — but placement selects which
+    COLLECTIVE programs a process runs (the sharded per-epoch gather vs
+    per-step puts), so a split verdict would deadlock the pod at the first
+    epoch. Every process calls this exactly once during resolution (the
+    ``requested_global`` pattern, utils/preempt.py) and all act on the AND:
+    one over-budget host sends the whole job to host placement. Single
+    process short-circuits — no collective in the common case.
+    """
+    if jax.process_count() == 1:
+        return local_ok
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([local_ok], np.int32)
+    )
+    return bool(np.asarray(flags).all())
+
+
+def resolve_data_placement(
+    placement: str,
+    images: np.ndarray,
+    labels: np.ndarray,
+    global_batch_size: int,
+    mesh,
+    budget_bytes: Optional[int] = None,
+) -> str:
+    """The ``--data_placement`` decision, logged. Returns 'host' or 'device'.
+
+    - ``host``: always honored (the pre-existing per-step H2D loop).
+    - ``device``: honored or a loud ``ValueError`` at startup — an explicit
+      request that cannot be satisfied must fail before the first step, not
+      OOM mid-run or silently degrade. On a multi-host job ANY process's
+      rejection raises on EVERY process (collective verdict): one host
+      erroring out while its peers build the store would strand the peers
+      in the store's collectives.
+    - ``auto``: 'device' when the dataset is a plain in-RAM array within the
+      budget ON EVERY PROCESS, else 'host' with a one-line startup banner
+      naming the reason (memmap-backed, the computed bytes vs budget, or a
+      peer's rejection).
+    """
+    if placement == "host":
+        return "host"
+    if placement not in ("device", "auto"):
+        raise ValueError(f"unknown data_placement {placement!r}")
+
+    def reject(reason: str) -> str:
+        if placement == "device":
+            raise ValueError(
+                f"--data_placement device cannot be satisfied: {reason} — "
+                f"use 'auto' (falls back to host with a banner) or 'host'"
+            )
+        logger.warning("data_placement auto -> host: %s", reason)
+        return "host"
+
+    if _is_memmap_backed(images) or _is_memmap_backed(labels):
+        local_reason = (
+            "dataset is memmap-backed (data/folder.py on-disk cache); "
+            "device residency would page the whole tree into RAM/HBM"
+        )
+        need = budget = None
+    else:
+        data_parallel = mesh.shape.get(DATA_AXIS, 1)
+        need = resident_bytes_per_device(
+            images, labels, global_batch_size, data_parallel
+        )
+        budget = device_budget_bytes() if budget_bytes is None else budget_bytes
+        local_reason = None if need <= budget else (
+            f"dataset needs {need / 1e6:.1f} MB/device (replicated data + "
+            f"2x epoch-buffer shard) > budget {budget / 1e6:.1f} MB"
+        )
+    # every process reaches this exact point once, whatever its local
+    # verdict — the allgather schedules must match
+    ok_everywhere = _agree_across_processes(local_reason is None)
+    if local_reason is not None:
+        return reject(local_reason)
+    if not ok_everywhere:
+        return reject(
+            "a peer process rejected device placement (per-host free-memory "
+            "budgets differ); placement selects collective programs, so it "
+            "must agree across hosts"
+        )
+    logger.info(
+        "data_placement: device (%.1f MB/device resident: %.1f MB dataset "
+        "+ double-buffered epoch shard; budget %.1f MB)",
+        need / 1e6, dataset_nbytes(images, labels) / 1e6, budget / 1e6,
+    )
+    return "device"
+
+
+def make_store(
+    placement: str, loader, mesh, budget_bytes: Optional[int] = None,
+) -> Optional["DeviceStore"]:
+    """The drivers' one-call entry point: resolve ``--data_placement``
+    against the LOADER'S OWN arrays and geometry, build the store if the
+    verdict is 'device', else return ``None`` (the host loop).
+
+    Resolving from ``loader.images``/``loader.labels`` (not the raw
+    ``load_dataset`` arrays) matters: the loader may have copied a
+    non-contiguous input via ``ascontiguousarray``, and what resolution
+    inspects must be exactly what the store would upload — two sources
+    could drift on the memmap check.
+    """
+    placement = resolve_data_placement(
+        placement, loader.images, loader.labels, loader.global_batch_size,
+        mesh, budget_bytes=budget_bytes,
+    )
+    return DeviceStore(loader, mesh) if placement == "device" else None
+
+
+def epoch_index_matrix(loader, epoch: int) -> np.ndarray:
+    """The epoch's global batch composition as a ``[steps, batch]`` int32
+    matrix — EXACTLY ``EpochLoader``'s permutation, drop_last-truncated and
+    reshaped. Row ``s`` column range ``[p*per_proc, (p+1)*per_proc)`` is
+    process ``p``'s slice of step ``s``'s global batch (pipeline.py
+    ``_batches``), which is why sharding the matrix column-wise over the
+    'data' axis reproduces the multi-host layout."""
+    order = loader._epoch_order(epoch)
+    steps, batch = loader.steps_per_epoch, loader.global_batch_size
+    return np.ascontiguousarray(
+        order[: steps * batch].reshape(steps, batch).astype(np.int32)
+    )
+
+
+def slice_epoch_step(epoch_images, epoch_labels, position):
+    """One step's batch out of the resident ``[steps, batch, ...]`` buffers:
+    a contiguous leading-axis dynamic slice (each device slices its own
+    batch shard locally — no communication, no gather)."""
+    images = jax.lax.dynamic_index_in_dim(
+        epoch_images, position, axis=0, keepdims=False
+    )
+    labels = jax.lax.dynamic_index_in_dim(
+        epoch_labels, position, axis=0, keepdims=False
+    )
+    return images, labels
+
+
+class DeviceStore:
+    """HBM-resident dataset + per-epoch shuffled buffer for one loader.
+
+    Wraps the driver's ``EpochLoader`` — the store never computes its own
+    permutation or geometry, so host and device placement cannot drift.
+
+    ``index_put`` is the injectable per-epoch index upload (tests assert the
+    one-transfer-per-epoch contract through it, the MetricRing pattern).
+    """
+
+    def __init__(
+        self,
+        loader,
+        mesh,
+        *,
+        index_put: Optional[Callable[[np.ndarray], jax.Array]] = None,
+    ):
+        if not loader.drop_last:
+            raise ValueError(
+                "DeviceStore requires drop_last loaders (the training path);"
+                " ragged tails have no static step shape"
+            )
+        data_parallel = mesh.shape.get(DATA_AXIS, 1)
+        if loader.global_batch_size % data_parallel != 0:
+            raise ValueError(
+                f"global batch {loader.global_batch_size} not divisible by "
+                f"the mesh's {data_parallel}-way data axis"
+            )
+        self.loader = loader
+        self.mesh = mesh
+        self.steps_per_epoch = loader.steps_per_epoch
+        self.global_batch_size = loader.global_batch_size
+
+        repl = replicated_sharding(mesh)
+        img_ndim = loader.images.ndim
+        # same [S, B] layout as the labels epoch buffer — the index columns
+        # must stay aligned with the buffer slices they produce
+        self._idx_sharding = epoch_buffer_sharding(mesh, 2)
+        self._index_put = index_put or (
+            lambda idx: jax.make_array_from_callback(
+                idx.shape, self._idx_sharding, lambda i: idx[i]
+            )
+        )
+        # the one-time upload: full dataset replicated per device (each
+        # process feeds its own local devices from its own in-RAM copy)
+        labels32 = np.ascontiguousarray(np.asarray(loader.labels, np.int32))
+        images = np.ascontiguousarray(loader.images)
+        self.images = jax.make_array_from_callback(
+            images.shape, repl, lambda i: images[i]
+        )
+        self.labels = jax.make_array_from_callback(
+            labels32.shape, repl, lambda i: labels32[i]
+        )
+
+        def gather(ds_images, ds_labels, idx):
+            # [S, B] indices into the replicated [N, ...] dataset -> the
+            # shuffled [S, B, ...] epoch buffer; indices are host-validated
+            # by construction (a permutation of range(N))
+            return (
+                jnp.take(ds_images, idx, axis=0, mode="clip"),
+                jnp.take(ds_labels, idx, axis=0, mode="clip"),
+            )
+
+        self._gather = jax.jit(
+            gather,
+            in_shardings=(repl, repl, self._idx_sharding),
+            out_shardings=(
+                epoch_buffer_sharding(mesh, img_ndim + 1),
+                epoch_buffer_sharding(mesh, 2),
+            ),
+        )
+        self._cached_epoch: Optional[int] = None
+        self._buffers: Optional[Tuple[jax.Array, jax.Array]] = None
+
+    def epoch_buffers(self, epoch: int) -> Tuple[jax.Array, jax.Array]:
+        """The epoch's shuffled resident ``(images[S,B,H,W,C], labels[S,B])``.
+
+        One int32 index upload + one compiled gather per epoch; repeated
+        calls for the same epoch return the cached buffers. The previous
+        epoch's buffers are dropped as the new ones land (the 2x
+        double-buffer bound in :func:`resident_bytes_per_device`).
+        """
+        if self._cached_epoch != epoch:
+            idx = self._index_put(epoch_index_matrix(self.loader, epoch))
+            self._buffers = self._gather(self.images, self.labels, idx)
+            self._cached_epoch = epoch
+        return self._buffers

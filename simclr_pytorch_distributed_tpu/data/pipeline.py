@@ -97,6 +97,23 @@ class EpochLoader:
                 f"({global_batch_size})"
             )
 
+    def check_start_step(self, start_step: int) -> None:
+        """Validate a mid-epoch resume offset, loudly.
+
+        Out of range means the checkpoint's ``step_in_epoch`` no longer fits
+        this run's geometry (e.g. a changed batch size shrank
+        ``steps_per_epoch``) — resuming would silently skip work. Drivers
+        call this BEFORE entering their step loop: both loop shapes iterate
+        ``range(start_step, steps_per_epoch)``, which an oversized offset
+        would turn into a silent zero-step epoch (the generator's own check
+        only fires on the first ``next``, which an empty range never does).
+        """
+        if not 0 <= start_step < self.steps_per_epoch:
+            raise ValueError(
+                f"start_step {start_step} outside [0, {self.steps_per_epoch})"
+                f" — the driver must roll a full-epoch offset into `epoch`"
+            )
+
     def _epoch_order(self, epoch: int) -> np.ndarray:
         n = len(self.images)
         if self.shuffle:
@@ -128,17 +145,14 @@ class EpochLoader:
         With ``prefetch > 0``, batch assembly runs in a daemon thread so the
         native gather for step k+1 overlaps the device step for batch k.
         """
-        if not 0 <= start_step < self.steps_per_epoch:
-            raise ValueError(
-                f"start_step {start_step} outside [0, {self.steps_per_epoch})"
-                f" — the driver must roll a full-epoch offset into `epoch`"
-            )
+        self.check_start_step(start_step)
         if self.prefetch <= 0:
             yield from self._batches(epoch, start_step)
             return
 
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         sentinel = object()
+        stop = threading.Event()
 
         def worker():
             # A raise here must not strand the consumer in q.get(): ship the
@@ -147,23 +161,42 @@ class EpochLoader:
             # with a real traceback instead of a collective timeout.
             try:
                 for item in self._batches(epoch, start_step):
+                    if stop.is_set():
+                        return
                     q.put(item)
             except BaseException as e:  # noqa: BLE001 — forwarded, not handled
                 q.put(e)
                 return
             q.put(sentinel)
 
-        t = threading.Thread(target=worker, daemon=True)
+        t = threading.Thread(
+            target=worker, daemon=True, name="EpochLoader-prefetch"
+        )
         t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            if isinstance(item, BaseException):
-                t.join()
-                raise item
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                if isinstance(item, BaseException):
+                    t.join()
+                    raise item
+                yield item
+            t.join()
+        finally:
+            # A consumer that abandons the iterator mid-epoch (preemption,
+            # an exception between batches, GC of the generator) closes it,
+            # which raises GeneratorExit at the yield above — without this,
+            # the worker would block in q.put() forever. Stop it and drain
+            # the queue until it exits: a worker blocked in put() gets space,
+            # then observes `stop` before producing another batch.
+            stop.set()
+            while t.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                t.join(timeout=0.05)
 
     def __len__(self) -> int:
         return self.steps_per_epoch

@@ -129,6 +129,19 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def epoch_buffer_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Layout of a device-resident ``[steps, batch, ...]`` epoch buffer
+    (data/device_store.py): the BATCH dim sharded over 'data', the steps dim
+    replicated. Each device therefore holds its own batch slice of EVERY
+    step, so the per-step ``lax.dynamic_slice`` on the leading axis is a
+    purely local slice — no communication in the hot loop — and on a
+    multi-host mesh each process's devices hold exactly that process's
+    ``EpochLoader`` slice of every global batch."""
+    if ndim < 2:
+        raise ValueError(f"epoch buffers are [steps, batch, ...]; got ndim={ndim}")
+    return NamedSharding(mesh, P(None, DATA_AXIS, *([None] * (ndim - 2))))
+
+
 def batch_sharding_if_divisible(mesh: Mesh, batch: int, ndim: int = 1) -> NamedSharding:
     """Batch sharding when the size divides the 'data' axis, else replicated.
 

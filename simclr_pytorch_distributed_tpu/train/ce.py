@@ -24,6 +24,7 @@ from simclr_pytorch_distributed_tpu.data.cifar import (
     ensure_dataset_available,
     load_dataset,
 )
+from simclr_pytorch_distributed_tpu.data import device_store
 from simclr_pytorch_distributed_tpu.data.pipeline import EpochLoader
 from simclr_pytorch_distributed_tpu.models import SupCEResNet
 from simclr_pytorch_distributed_tpu.ops.augment import (
@@ -71,10 +72,11 @@ class CEState(struct.PyTreeNode):
     opt_state: Any
 
 
-def make_ce_steps(model, tx, aug_cfg, mesh, metric_ring=None):
+def make_ce_steps(model, tx, aug_cfg, mesh, metric_ring=None, resident_steps=None):
     """``metric_ring`` switches the train step to ring telemetry (see
     train/supcon.make_fused_update); ``None`` keeps the scalar-returning
-    signature (bench.py)."""
+    signature (bench.py). ``resident_steps`` switches the train step's data
+    args to the device-resident epoch buffers (jit_scalar_or_ring_step)."""
     repl = replicated_sharding(mesh)
 
     def train_step(state: CEState, images_u8, labels, base_key):
@@ -118,7 +120,9 @@ def make_ce_steps(model, tx, aug_cfg, mesh, metric_ring=None):
             "n": jnp.sum(valid),
         }
 
-    train_jit = jit_scalar_or_ring_step(train_step, metric_ring, mesh)
+    train_jit = jit_scalar_or_ring_step(
+        train_step, metric_ring, mesh, resident_steps=resident_steps
+    )
     eval_jit = jax.jit(
         eval_step,
         in_shardings=(repl, batch_sharding(mesh, 4), batch_sharding(mesh, 1),
@@ -179,10 +183,14 @@ def run(cfg: config_lib.LinearConfig):
 
     mean, std = stats_for(cfg.dataset)
     aug_cfg = AugmentConfig(size=cfg.size, mean=mean, std=std, color_ops=False)
+    # --data_placement (data/device_store.py): HBM-resident train set,
+    # dispatch-only hot loop; 'auto' degrades to the host loop with a banner
+    store = device_store.make_store(cfg.data_placement, loader, mesh)
     # device-side metric ring + background flush (utils/telemetry.py)
     telemetry = TelemetrySession(cfg.print_freq, PROBE_METRIC_KEYS, cfg.telemetry)
     train_jit, eval_jit = make_ce_steps(
-        model, tx, aug_cfg, mesh, metric_ring=telemetry.ring
+        model, tx, aug_cfg, mesh, metric_ring=telemetry.ring,
+        resident_steps=steps_per_epoch if store is not None else None,
     )
 
     start_epoch, start_step = 1, 0
@@ -242,36 +250,53 @@ def run(cfg: config_lib.LinearConfig):
                                          step_hint=step_hint)
 
             ss = start_step if epoch == start_epoch else 0
-            for idx, (images_u8, labels) in enumerate(
-                loader.epoch(epoch, start_step=ss), start=ss
-            ):
-                gstep = (epoch - 1) * steps_per_epoch + idx  # == state.step
-                batch = shard_host_batch((images_u8, labels), mesh)
-                state, ring_buf = train_jit(
-                    state, ring_buf, batch[0], batch[1], base_key
-                )
-                telemetry.append(idx, gstep)
-                if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
-                    submit_window(idx, ring_buf, gstep)
-                    if idx + 1 < steps_per_epoch and preempt.requested_global():
-                        # SIGTERM/SIGINT at a flush boundary, decided
-                        # collectively on the MAIN thread (see
-                        # train/supcon.py — independent of any in-flight
-                        # flush). Drain COLLECTIVELY (a host-local raise
-                        # here would skip the collective emergency save
-                        # while peers enter it) so the mid-epoch save —
-                        # collective, same semantics as the pretrain driver
-                        # — sees complete metrics; the distinct exit code
-                        # tells the launcher to re-run with --resume.
-                        telemetry.drain_global(gstep)
-                        preempt.emergency_save_and_exit(
-                            cfg.save_folder,
-                            f"preempt_epoch_{epoch}_step_{idx + 1}",
-                            state_for_save(state),
-                            config_lib.config_dict(cfg), epoch - 1,
-                            step_in_epoch=idx + 1, extra_meta=run_meta(),
-                            cleanup=(tb.close, telemetry.close),
+            # both loop shapes iterate range(ss, steps_per_epoch) — an
+            # oversized resume offset (changed geometry) must raise, not
+            # silently complete a zero-step epoch
+            loader.check_start_step(ss)
+            if store is not None:
+                epoch_images, epoch_labels = store.epoch_buffers(epoch)
+                batches = None
+            else:
+                batches = loader.epoch(epoch, start_step=ss)
+            try:
+                for idx in range(ss, steps_per_epoch):
+                    gstep = (epoch - 1) * steps_per_epoch + idx  # == state.step
+                    if batches is None:
+                        state, ring_buf = train_jit(
+                            state, ring_buf, epoch_images, epoch_labels, base_key
                         )
+                    else:
+                        images_u8, labels = next(batches)
+                        batch = shard_host_batch((images_u8, labels), mesh)
+                        state, ring_buf = train_jit(
+                            state, ring_buf, batch[0], batch[1], base_key
+                        )
+                    telemetry.append(idx, gstep)
+                    if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
+                        submit_window(idx, ring_buf, gstep)
+                        if idx + 1 < steps_per_epoch and preempt.requested_global():
+                            # SIGTERM/SIGINT at a flush boundary, decided
+                            # collectively on the MAIN thread (see
+                            # train/supcon.py — independent of any in-flight
+                            # flush). Drain COLLECTIVELY (a host-local raise
+                            # here would skip the collective emergency save
+                            # while peers enter it) so the mid-epoch save —
+                            # collective, same semantics as the pretrain driver
+                            # — sees complete metrics; the distinct exit code
+                            # tells the launcher to re-run with --resume.
+                            telemetry.drain_global(gstep)
+                            preempt.emergency_save_and_exit(
+                                cfg.save_folder,
+                                f"preempt_epoch_{epoch}_step_{idx + 1}",
+                                state_for_save(state),
+                                config_lib.config_dict(cfg), epoch - 1,
+                                step_in_epoch=idx + 1, extra_meta=run_meta(),
+                                cleanup=(tb.close, telemetry.close),
+                            )
+            finally:
+                if batches is not None:
+                    batches.close()  # stop the prefetch worker on early exit
             # flush any short-epoch tail, then drain COLLECTIVELY ahead of
             # the scheduled save (the ordering contract lives on the session)
             telemetry.finish_epoch(

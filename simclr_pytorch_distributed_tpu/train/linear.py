@@ -35,6 +35,8 @@ from simclr_pytorch_distributed_tpu.data.cifar import (
     ensure_dataset_available,
     load_dataset,
 )
+from simclr_pytorch_distributed_tpu.data import device_store
+from simclr_pytorch_distributed_tpu.data.device_store import slice_epoch_step
 from simclr_pytorch_distributed_tpu.data.pipeline import EpochLoader
 from simclr_pytorch_distributed_tpu.models import (
     MODEL_DICT,
@@ -54,6 +56,7 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
     batch_sharding,
     broadcast_from_main,
     create_mesh,
+    epoch_buffer_sharding,
     is_main_process,
     replicated_sharding,
     setup_distributed,
@@ -62,6 +65,7 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
 )
 from simclr_pytorch_distributed_tpu.train.state import make_optimizer
 from simclr_pytorch_distributed_tpu.train.supcon import enable_compile_cache
+from simclr_pytorch_distributed_tpu.train.supcon_step import epoch_position
 from simclr_pytorch_distributed_tpu.utils import preempt
 from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     load_pretrained_variables,
@@ -124,26 +128,42 @@ def topk_correct(logits: jax.Array, labels: jax.Array, ks=(1, 5)):
     return {k: jnp.sum(jnp.any(hit[:, :k], axis=1)) for k in ks}
 
 
-def jit_scalar_or_ring_step(step_fn, metric_ring, mesh):
+def jit_scalar_or_ring_step(step_fn, metric_ring, mesh, resident_steps=None):
     """Jit a ``(state, images_u8, labels, key) -> (state, metrics)`` train
     step for a probe-style driver. With ``metric_ring`` the step is wrapped
     to write its metrics into the donated device ring at ``state.step``
     (``(state, ring, images, labels, key) -> (state, ring)``, see
     train/supcon.make_fused_update); ``None`` keeps the scalar-returning
-    signature (bench.py). Shared by the probe and CE builders so the ring
-    wiring (shardings + donation) cannot diverge between them."""
+    signature (bench.py). ``resident_steps`` (the loader's steps_per_epoch)
+    switches the data arguments to the device-resident ``[steps, batch, ...]``
+    epoch buffers (data/device_store.py): the program slices its own batch
+    at ``state.step % resident_steps`` and the buffers are NOT donated.
+    Shared by the probe and CE builders so the ring/resident wiring
+    (shardings + donation) cannot diverge between them."""
     repl = replicated_sharding(mesh)
-    data = (batch_sharding(mesh, 4), batch_sharding(mesh, 1))
+    if resident_steps is None:
+        data = (batch_sharding(mesh, 4), batch_sharding(mesh, 1))
+        sliced_step = step_fn
+    else:
+        data = (epoch_buffer_sharding(mesh, 5), epoch_buffer_sharding(mesh, 2))
+
+        def sliced_step(state, epoch_images, epoch_labels, base_key):
+            images_u8, labels = slice_epoch_step(
+                epoch_images, epoch_labels,
+                epoch_position(state.step, resident_steps),
+            )
+            return step_fn(state, images_u8, labels, base_key)
+
     if metric_ring is None:
         return jax.jit(
-            step_fn,
+            sliced_step,
             in_shardings=(repl, *data, repl),
             out_shardings=(repl, repl),
             donate_argnums=(0,),
         )
 
-    def ring_step(state, ring, images_u8, labels, base_key):
-        new_state, metrics = step_fn(state, images_u8, labels, base_key)
+    def ring_step(state, ring, images_arg, labels_arg, base_key):
+        new_state, metrics = sliced_step(state, images_arg, labels_arg, base_key)
         return new_state, metric_ring.write(ring, metrics, state.step)
 
     return jax.jit(
@@ -154,11 +174,17 @@ def jit_scalar_or_ring_step(step_fn, metric_ring, mesh):
     )
 
 
-def make_probe_steps(classifier, tx, encode, aug_cfg, eval_cfg, mesh, metric_ring=None):
+def make_probe_steps(
+    classifier, tx, encode, aug_cfg, eval_cfg, mesh, metric_ring=None,
+    resident_steps=None,
+):
     """``metric_ring`` switches the train step to ring telemetry —
     ``(state, ring, images, labels, key) -> (state, ring)`` with the metrics
     written on device (see train/supcon.make_fused_update); ``None`` keeps
-    the scalar-returning signature (bench.py)."""
+    the scalar-returning signature (bench.py). ``resident_steps`` switches
+    the train step's data args to the device-resident epoch buffers
+    (jit_scalar_or_ring_step); validation always streams from the host (it
+    runs once per epoch — not a hot path)."""
     repl = replicated_sharding(mesh)
 
     def train_step(state: ProbeState, images_u8, labels, base_key):
@@ -193,7 +219,9 @@ def make_probe_steps(classifier, tx, encode, aug_cfg, eval_cfg, mesh, metric_rin
         top5 = jnp.sum(jnp.any(maxk_hit, axis=1) * valid)
         return {"loss_sum": loss_sum, "top1": top1, "top5": top5, "n": jnp.sum(valid)}
 
-    train_jit = jit_scalar_or_ring_step(train_step, metric_ring, mesh)
+    train_jit = jit_scalar_or_ring_step(
+        train_step, metric_ring, mesh, resident_steps=resident_steps
+    )
     eval_jit = jax.jit(
         eval_step,
         in_shardings=(repl, batch_sharding(mesh, 4), batch_sharding(mesh, 1),
@@ -256,6 +284,10 @@ def run(cfg: config_lib.LinearConfig):
         process_count=jax.process_count(),
     )
     steps_per_epoch = len(loader)
+    # --data_placement (data/device_store.py): 'device' keeps the train set
+    # HBM-resident — the probe step is SMALL, so the per-step H2D was a
+    # proportionally bigger slice of its loop than the pretrain driver's
+    store = device_store.make_store(cfg.data_placement, loader, mesh)
 
     # encoder variables from the pretrain checkpoint (main_linear.py:125-142)
     dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
@@ -284,7 +316,9 @@ def run(cfg: config_lib.LinearConfig):
     # bigger slice of its loop than the pretrain driver's
     telemetry = TelemetrySession(cfg.print_freq, PROBE_METRIC_KEYS, cfg.telemetry)
     train_jit, eval_jit = make_probe_steps(
-        classifier, tx, encode, aug_cfg, aug_cfg, mesh, metric_ring=telemetry.ring
+        classifier, tx, encode, aug_cfg, aug_cfg, mesh,
+        metric_ring=telemetry.ring,
+        resident_steps=steps_per_epoch if store is not None else None,
     )
 
     tb = TBLogger(cfg.tb_folder, enabled=is_main_process())
@@ -336,22 +370,37 @@ def run(cfg: config_lib.LinearConfig):
                 telemetry.flush_boundary(ring_buf, consume, batch_meter=bt,
                                          step_hint=step_hint)
 
-            for idx, (images_u8, labels) in enumerate(loader.epoch(epoch)):
-                gstep = (epoch - 1) * steps_per_epoch + idx  # == state.step
-                batch = shard_host_batch((images_u8, labels), mesh)
-                state, ring_buf = train_jit(
-                    state, ring_buf, batch[0], batch[1], base_key
-                )
-                telemetry.append(idx, gstep)
-                if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
-                    submit_window(idx, ring_buf, gstep)
-                    if preempt.requested_global():
-                        # collective decision (see train/supcon.py), on the
-                        # MAIN thread — independent of any in-flight flush:
-                        # all hosts leave the loop at the same boundary,
-                        # keeping the end-of-run barriers matched
-                        preempted = True
-                        break
+            if store is not None:
+                epoch_images, epoch_labels = store.epoch_buffers(epoch)
+                batches = None
+            else:
+                batches = loader.epoch(epoch)
+            try:
+                for idx in range(steps_per_epoch):
+                    gstep = (epoch - 1) * steps_per_epoch + idx  # == state.step
+                    if batches is None:
+                        state, ring_buf = train_jit(
+                            state, ring_buf, epoch_images, epoch_labels, base_key
+                        )
+                    else:
+                        images_u8, labels = next(batches)
+                        batch = shard_host_batch((images_u8, labels), mesh)
+                        state, ring_buf = train_jit(
+                            state, ring_buf, batch[0], batch[1], base_key
+                        )
+                    telemetry.append(idx, gstep)
+                    if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
+                        submit_window(idx, ring_buf, gstep)
+                        if preempt.requested_global():
+                            # collective decision (see train/supcon.py), on the
+                            # MAIN thread — independent of any in-flight flush:
+                            # all hosts leave the loop at the same boundary,
+                            # keeping the end-of-run barriers matched
+                            preempted = True
+                            break
+            finally:
+                if batches is not None:
+                    batches.close()  # stop the prefetch worker on early exit
             # flush any short-epoch tail, then drain COLLECTIVELY ahead of
             # the end-of-run save (the ordering contract lives on the session)
             telemetry.finish_epoch(

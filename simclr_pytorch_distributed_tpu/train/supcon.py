@@ -31,6 +31,8 @@ from simclr_pytorch_distributed_tpu.data.cifar import (
     ensure_dataset_available,
     load_dataset,
 )
+from simclr_pytorch_distributed_tpu.data import device_store
+from simclr_pytorch_distributed_tpu.data.device_store import slice_epoch_step
 from simclr_pytorch_distributed_tpu.data.pipeline import EpochLoader
 from simclr_pytorch_distributed_tpu.models import SupConResNet
 from simclr_pytorch_distributed_tpu.ops.augment import (
@@ -45,6 +47,7 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
     batch_sharding,
     broadcast_from_main,
     create_mesh,
+    epoch_buffer_sharding,
     is_main_process,
     replicated_sharding,
     setup_distributed,
@@ -61,6 +64,7 @@ from simclr_pytorch_distributed_tpu.train.state import (
 from simclr_pytorch_distributed_tpu.train.supcon_step import (
     METRIC_KEYS,
     SupConStepConfig,
+    epoch_position,
     make_train_step,
 )
 from simclr_pytorch_distributed_tpu.utils.checkpoint import (
@@ -171,7 +175,8 @@ def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1
 
 
 def make_fused_update(
-    model, tx, schedule, step_cfg, aug_cfg, mesh, state_example, metric_ring=None,
+    model, tx, schedule, step_cfg, aug_cfg, mesh, state_example,
+    metric_ring=None, resident=False,
 ):
     """augment(two crops) + train step as one GSPMD program.
 
@@ -189,37 +194,49 @@ def make_fused_update(
     flush then needs ONE contiguous D2H per window (docs/PERF.md zero-sync
     telemetry). ``None`` keeps the scalar-returning signature (bench.py, the
     dryrun modes, and the distributed-equivalence tests).
+
+    ``resident`` switches the data arguments from one host-fed batch to the
+    device-resident ``[steps, batch, ...]`` epoch buffers
+    (data/device_store.py): the program slices its own batch at
+    ``state.step % steps_per_epoch`` (train/supcon_step.epoch_position) so
+    the hot loop carries NO per-step host work or transfer. The buffers are
+    deliberately NOT donated — every step of the epoch reads them.
     """
     train_step = make_train_step(model, tx, schedule, step_cfg, mesh=mesh)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding(mesh, state_example)
+    if resident:
+        data_sh = (
+            epoch_buffer_sharding(mesh, 5), epoch_buffer_sharding(mesh, 2),
+        )
+    else:
+        data_sh = (batch_sharding(mesh, 4), batch_sharding(mesh, 1))
+
+    def core(state: TrainState, images_arg, labels_arg, base_key):
+        if resident:
+            pos = epoch_position(state.step, step_cfg.steps_per_epoch)
+            images_u8, labels = slice_epoch_step(images_arg, labels_arg, pos)
+        else:
+            images_u8, labels = images_arg, labels_arg
+        key = jax.random.fold_in(base_key, state.step)
+        views = two_crop_batch(key, images_u8, aug_cfg)
+        return train_step(state, views, labels)
 
     if metric_ring is None:
-        def update(state: TrainState, images_u8, labels, base_key):
-            key = jax.random.fold_in(base_key, state.step)
-            views = two_crop_batch(key, images_u8, aug_cfg)
-            return train_step(state, views, labels)
-
         return jax.jit(
-            update,
-            in_shardings=(
-                state_sh, batch_sharding(mesh, 4), batch_sharding(mesh, 1), repl,
-            ),
+            core,
+            in_shardings=(state_sh, *data_sh, repl),
             out_shardings=(state_sh, repl),
             donate_argnums=(0,),
         )
 
-    def ring_update(state: TrainState, ring, images_u8, labels, base_key):
-        key = jax.random.fold_in(base_key, state.step)
-        views = two_crop_batch(key, images_u8, aug_cfg)
-        new_state, metrics = train_step(state, views, labels)
+    def ring_update(state: TrainState, ring, images_arg, labels_arg, base_key):
+        new_state, metrics = core(state, images_arg, labels_arg, base_key)
         return new_state, metric_ring.write(ring, metrics, state.step)
 
     return jax.jit(
         ring_update,
-        in_shardings=(
-            state_sh, repl, batch_sharding(mesh, 4), batch_sharding(mesh, 1), repl,
-        ),
+        in_shardings=(state_sh, repl, *data_sh, repl),
         out_shardings=(state_sh, repl),
         donate_argnums=(0, 1),
     )
@@ -232,7 +249,7 @@ TB_ITER_SCALARS = (  # reference per-iter scalars, main_supcon.py:327-333
 
 def train_one_epoch(
     epoch, loader, update_fn, state, mesh, base_key, cfg, tb, steps_per_epoch,
-    tracer=None, start_step=0, telemetry=None,
+    tracer=None, start_step=0, telemetry=None, store=None,
 ):
     """One epoch (reference train(), main_supcon.py:242-351).
 
@@ -254,6 +271,16 @@ def train_one_epoch(
     was restored from the checkpoint, so the in-program per-step PRNG keys
     line up with the uninterrupted run). The ring is transient (never
     checkpointed); a fresh one is created here each epoch.
+
+    ``store`` (a data/device_store.DeviceStore) switches the epoch to the
+    device-resident data path: one index upload + compiled shuffle-gather at
+    epoch start, then every step dispatches against the SAME resident
+    buffers (``update_fn`` built with ``resident=True`` slices its own batch
+    at ``state.step % steps_per_epoch``) — no host gather, no per-step H2D.
+    The permutation source is the same ``loader``, so batch composition is
+    bit-identical either way; under resume the slice position follows the
+    restored step counter, so ``start_step`` only sets where this host loop
+    begins.
 
     Each flush boundary also checks the preemption flag (utils/preempt.py)
     ON THE MAIN THREAD — the collective decision never depended on the D2H
@@ -318,16 +345,32 @@ def train_one_epoch(
     def epoch_loss_avg():
         return losses.avg if losses.count else last_host.get("loss", 0.0)
 
+    # both loop shapes iterate range(start_step, steps_per_epoch) — an
+    # oversized resume offset (changed geometry) must raise, not silently
+    # complete a zero-step epoch
+    loader.check_start_step(start_step)
+    if store is not None:
+        epoch_images, epoch_labels = store.epoch_buffers(epoch)
+        batches = None
+    else:
+        batches = loader.epoch(epoch, start_step=start_step)
     try:
-        for idx, (images_u8, labels) in enumerate(
-            loader.epoch(epoch, start_step=start_step), start=start_step
-        ):
-            data_time.update(time.time() - end)
+        for idx in range(start_step, steps_per_epoch):
+            if batches is not None:
+                images_u8, labels = next(batches)
+            data_time.update(time.time() - end)  # resident: nothing staged
             global_step = (epoch - 1) * steps_per_epoch + idx
-            batch = shard_host_batch((images_u8, labels), mesh)
             # per-step key = fold_in(base_key, state.step) INSIDE the program
             # (state.step == global_step); see make_fused_update
-            state, ring_buf = update_fn(state, ring_buf, batch[0], batch[1], base_key)
+            if batches is None:
+                state, ring_buf = update_fn(
+                    state, ring_buf, epoch_images, epoch_labels, base_key
+                )
+            else:
+                batch = shard_host_batch((images_u8, labels), mesh)
+                state, ring_buf = update_fn(
+                    state, ring_buf, batch[0], batch[1], base_key
+                )
             telemetry.append((idx, global_step), global_step)
             if tracer is not None:
                 tracer.step(global_step)
@@ -359,6 +402,12 @@ def train_one_epoch(
         )
         return state, epoch_loss_avg(), dict(last_host), None
     finally:
+        if batches is not None:
+            # an early return (preemption) or a raise abandons the loader's
+            # generator mid-epoch; close() stops its prefetch worker
+            # (data/pipeline.py handles GeneratorExit) instead of leaving it
+            # blocked in q.put()
+            batches.close()
         if owns_telemetry:
             telemetry.close()
 
@@ -403,6 +452,11 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         process_count=jax.process_count(),
     )
     steps_per_epoch = len(loader)
+    # --data_placement: 'device' keeps the uint8 dataset HBM-resident and the
+    # hot loop dispatch-only; 'auto' falls back to the host loop (with a
+    # startup banner naming the reason) for memmap-backed or over-budget
+    # datasets (data/device_store.py)
+    store = device_store.make_store(cfg.data_placement, loader, mesh)
     model, schedule, tx, state, step_cfg = build(cfg, steps_per_epoch, mesh.size)
     logging.info("contrastive loss impl: %s", step_cfg.loss_impl)
 
@@ -441,7 +495,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         if lr_scale == 1.0:
             return make_fused_update(
                 model, tx, schedule, step_cfg, aug_cfg, mesh, state,
-                metric_ring=telemetry.ring,
+                metric_ring=telemetry.ring, resident=store is not None,
             )
         scaled = lambda s, sc=lr_scale: schedule(s) * sc  # noqa: E731
         return make_fused_update(
@@ -451,7 +505,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
                 weight_decay=cfg.weight_decay, optimizer=cfg.optimizer,
             ),
             scaled, step_cfg, aug_cfg, mesh, state,
-            metric_ring=telemetry.ring,
+            metric_ring=telemetry.ring, resident=store is not None,
         )
 
     # failure policy (utils/guard.py): what a NonFiniteLossError does to the
@@ -508,7 +562,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
                 state, loss_avg, metrics, preempted_at = train_one_epoch(
                     epoch, loader, update_fn, state, mesh, base_key, cfg, tb,
                     steps_per_epoch, tracer=tracer, start_step=ss,
-                    telemetry=telemetry,
+                    telemetry=telemetry, store=store,
                 )
             except NonFiniteLossError:
                 # emergency save of the epoch-top state so --resume can

@@ -64,6 +64,22 @@ METRIC_KEYS = (
 )
 
 
+def epoch_position(step, steps_per_epoch: int):
+    """A step's position within its epoch, derived ON DEVICE from the state's
+    global step counter — the resident-data slice index
+    (``data/device_store.py``: the step takes the epoch buffer as a
+    non-donated arg and slices row ``position`` out of it).
+
+    Valid because every driver maintains ``state.step == (epoch-1) *
+    steps_per_epoch + idx`` through ALL control flow: mid-epoch resume
+    restores the counter from checkpoint meta, and the NaN-rollback path
+    realigns it to the skipped epoch's boundary (train/supcon.py) — so the
+    remainder is always the in-epoch index and no extra per-step host scalar
+    (which would be an H2D transfer, docs/PERF.md) is needed.
+    """
+    return jax.lax.rem(step, jnp.int32(steps_per_epoch))
+
+
 @dataclasses.dataclass(frozen=True)
 class SupConStepConfig:
     """Static step configuration (mirrors the reference argparse flags)."""

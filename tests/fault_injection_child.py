@@ -5,7 +5,7 @@ resume — the only honest way to test the preemption machinery end-to-end
 (an in-process simulation cannot witness exit codes or kill -9 torn state).
 
 Usage: python fault_injection_child.py <workdir> <epochs> <resume> <trial> \
-           [save_freq]
+           [save_freq] [data_placement]
 
 Prints, on stdout (parent parses these):
 - ``SAVE_FOLDER <path>``  once config is finalized (before training);
@@ -53,6 +53,10 @@ epochs = int(sys.argv[2])
 resume = sys.argv[3]
 trial = sys.argv[4]
 save_freq = int(sys.argv[5]) if len(sys.argv) > 5 else 100
+# 'auto' resolves to DEVICE placement here (tiny in-RAM synthetic set on
+# CPU); the parent pins 'host' to prove the preemption/resume contract on
+# the per-step H2D loop too — it is placement-independent (RESILIENCE.md)
+data_placement = sys.argv[6] if len(sys.argv) > 6 else "auto"
 
 from simclr_pytorch_distributed_tpu.train import supcon as supcon_driver  # noqa: E402
 
@@ -60,7 +64,7 @@ cfg = config_lib.SupConConfig(
     model="resnet10", dataset="synthetic", batch_size=32, epochs=epochs,
     learning_rate=0.05, temp=0.5, cosine=True, save_freq=save_freq,
     print_freq=1, size=8, workdir=workdir, seed=0, method="SimCLR",
-    trial=trial, resume=resume,
+    trial=trial, resume=resume, data_placement=data_placement,
 )
 cfg = config_lib.finalize_supcon(cfg)
 print(f"SAVE_FOLDER {cfg.save_folder}", flush=True)

@@ -288,3 +288,34 @@ def test_linear_parser_accepts_resume_for_launcher_contract():
         ["--dataset", "synthetic", "--resume", "/some/run_dir"]
     )
     assert ns_ce.resume == "/some/run_dir"
+
+
+def test_data_placement_flag_all_parsers(tmp_path):
+    """--data_placement {host,device,auto} on all three trainers' parsers;
+    'auto' (decide from the decoded dataset size, degrade to host with a
+    banner) is the default everywhere."""
+    assert parse_supcon(["--workdir", str(tmp_path)]).data_placement == "auto"
+    assert parse_supcon(
+        ["--data_placement", "device", "--workdir", str(tmp_path)]
+    ).data_placement == "device"
+    assert parse_linear(["--workdir", str(tmp_path)]).data_placement == "auto"
+    assert parse_linear(
+        ["--data_placement", "host", "--workdir", str(tmp_path)], ce=True
+    ).data_placement == "host"
+    with pytest.raises(SystemExit):
+        parse_supcon(["--data_placement", "hbm", "--workdir", str(tmp_path)])
+
+
+def test_data_placement_device_with_path_rejected_at_parse(tmp_path):
+    """The 'device' x 'path' interaction dies AT PARSE TIME with the reason
+    (folder trees may decode to an on-disk memmap above --mmap_threshold_mb,
+    which residency refuses) — not deep in setup after the decode; 'auto'
+    with path parses fine and resolves against the decoded array later."""
+    path_args = ["--dataset", "path", "--data_folder", str(tmp_path),
+                 "--mean", "(0.5,0.5,0.5)", "--std", "(0.5,0.5,0.5)",
+                 "--workdir", str(tmp_path)]
+    with pytest.raises(ValueError, match="memmap"):
+        parse_supcon(["--data_placement", "device", *path_args])
+    assert parse_supcon(
+        ["--data_placement", "auto", *path_args]
+    ).data_placement == "auto"

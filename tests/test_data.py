@@ -135,6 +135,59 @@ def test_epoch_loader_prefetch_worker_exception_propagates():
         next(it)
 
 
+def test_epoch_loader_abandoned_iterator_stops_prefetch_worker():
+    """A consumer that walks away mid-epoch (preemption, an exception
+    between batches) must not strand the prefetch worker blocked in
+    ``q.put()`` forever: closing the generator (which is what GC does too)
+    stops and joins the worker thread."""
+    import threading
+    import time
+
+    def worker_threads():
+        return [
+            t for t in threading.enumerate()
+            if t.name == "EpochLoader-prefetch" and t.is_alive()
+        ]
+
+    images = np.arange(64)[:, None].astype(np.uint8)
+    labels = np.arange(64).astype(np.int32)
+    # prefetch=1: after the consumer takes one batch the worker is
+    # guaranteed to be BLOCKED in q.put() on the next one
+    loader = EpochLoader(images, labels, global_batch_size=8, prefetch=1)
+    assert not worker_threads()
+    it = loader.epoch(0)
+    next(it)
+    deadline = time.time() + 5
+    while not worker_threads() and time.time() < deadline:
+        time.sleep(0.01)  # let the worker reach the blocking put
+    assert worker_threads()
+    it.close()  # abandon mid-epoch
+    assert not worker_threads(), "prefetch worker leaked after abandon"
+
+    # the exhausted path still terminates cleanly too
+    assert len(list(loader.epoch(0))) == 8
+    assert not worker_threads()
+
+
+def test_check_start_step_rejects_out_of_range_resume_offsets():
+    """An oversized resume offset (a checkpoint whose step_in_epoch no
+    longer fits this run's geometry, e.g. a changed batch size) must raise
+    loudly — the drivers call this BEFORE their step loop, because both
+    loop shapes iterate range(start_step, steps_per_epoch) and an empty
+    range would otherwise 'complete' a zero-step epoch silently."""
+    images = np.arange(64)[:, None].astype(np.uint8)
+    labels = np.arange(64).astype(np.int32)
+    loader = EpochLoader(images, labels, global_batch_size=8)  # 8 steps
+    loader.check_start_step(0)
+    loader.check_start_step(7)
+    for bad in (-1, 8, 100):
+        with pytest.raises(ValueError, match="outside"):
+            loader.check_start_step(bad)
+    # epoch() still validates for direct consumers
+    with pytest.raises(ValueError, match="outside"):
+        next(loader.epoch(0, start_step=8))
+
+
 def test_synthetic_texture_dataset_contract():
     """Deterministic, disjoint split, labels in range, uint8 HWC — and class
     signal is NOT in the color channel means (ColorJitter robustness: unlike

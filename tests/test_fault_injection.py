@@ -43,13 +43,14 @@ class Child:
     """A driver subprocess whose stdout is streamed line-by-line so the test
     can react (send a signal) at a chosen training step."""
 
-    def __init__(self, workdir, epochs, resume="", trial="f", save_freq=100):
+    def __init__(self, workdir, epochs, resume="", trial="f", save_freq=100,
+                 data_placement="auto"):
         env = os.environ.copy()
         env["JAX_PLATFORMS"] = "cpu"
         env["JAX_COMPILATION_CACHE_DIR"] = os.path.abspath(CACHE)
         self.proc = subprocess.Popen(
             [sys.executable, CHILD, str(workdir), str(epochs), resume,
-             trial, str(save_freq)],
+             trial, str(save_freq), data_placement],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=os.path.dirname(os.path.dirname(CHILD)) or ".",
         )
@@ -114,16 +115,26 @@ def _find_preempt_save(run_dir):
     return os.path.join(run_dir, names[0])
 
 
-def test_sigterm_mid_epoch_emergency_save_and_bit_identical_resume(tmp_path):
+@pytest.mark.parametrize("placement", ["host", "auto"])
+def test_sigterm_mid_epoch_emergency_save_and_bit_identical_resume(
+    tmp_path, placement
+):
     """The tentpole proof. SIGTERM lands mid-epoch at a step chosen by run
     timing (randomized across runs by construction); the child must write an
     emergency checkpoint recording its intra-epoch position, exit with the
     distinct preemption code, and the resumed run must land on EXACTLY the
-    params an uninterrupted run of the same seed produces."""
+    params an uninterrupted run of the same seed produces.
+
+    Parametrized over ``--data_placement``: 'auto' resolves to the
+    device-resident epoch buffer on the child's in-RAM synthetic set, 'host'
+    pins the per-step H2D loop (the production path for memmap/over-budget
+    datasets) — the preemption contract is placement-independent
+    (docs/RESILIENCE.md), so BOTH driver loops must honor it."""
     import json
 
     # reference: uninterrupted 2-epoch run
-    ref = Child(tmp_path / "uninterrupted", epochs=2, trial="ref")
+    ref = Child(tmp_path / "uninterrupted", epochs=2, trial="ref",
+                data_placement=placement)
     ref.wait_for_line("DONE step=")
     assert ref.wait() == 0
     assert ref.grep(f"DONE step={2 * STEPS_PER_EPOCH}"), ref.lines[-5:]
@@ -131,7 +142,8 @@ def test_sigterm_mid_epoch_emergency_save_and_bit_identical_resume(tmp_path):
 
     # victim: SIGTERM after the first step's log line of epoch 1 — the flag
     # is observed at the next print_freq flush, strictly mid-epoch
-    victim = Child(tmp_path / "preempted", epochs=2, trial="victim")
+    victim = Child(tmp_path / "preempted", epochs=2, trial="victim",
+                   data_placement=placement)
     victim.wait_for_line("Train: [1][1/")
     victim.proc.send_signal(signal.SIGTERM)
     rc = victim.wait()
@@ -149,7 +161,7 @@ def test_sigterm_mid_epoch_emergency_save_and_bit_identical_resume(tmp_path):
 
     # resume from the RUN DIR (resolution must find the emergency save)
     resumed = Child(tmp_path / "preempted", epochs=2, resume=run_dir,
-                    trial="victim")
+                    trial="victim", data_placement=placement)
     resumed.wait_for_line("DONE step=")
     assert resumed.wait() == 0
     assert resumed.grep(f"resumed from {ppath} at epoch 1 step "

@@ -35,6 +35,10 @@ class _FakeLoader:
     def __init__(self, n_steps, batch):
         self.n_steps, self.batch = n_steps, batch
 
+    def check_start_step(self, start_step):
+        # the real EpochLoader contract the driver invokes pre-loop
+        assert 0 <= start_step < self.n_steps, start_step
+
     def epoch(self, _, start_step=0):
         images = np.zeros((self.batch, 4, 4, 3), np.uint8)
         labels = np.zeros((self.batch,), np.int32)

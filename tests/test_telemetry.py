@@ -471,13 +471,21 @@ def _tb_ab(run_fn):
     return streams
 
 
-def test_all_drivers_flush_boundary_smoke(tmp_path, tiny_drivers):
+@pytest.mark.parametrize("placement", ["host", "device"])
+def test_all_drivers_flush_boundary_smoke(tmp_path, tiny_drivers, placement):
     """FAST guard on the driver<->flush_boundary contract: one sync-mode
     epoch through each REAL trainer. Sync telemetry runs every window job
     inline, so a driver whose ``consume`` signature diverges from what
     ``flush_boundary`` calls (one arg vs the ``(fetched, bt)`` pair when
     ``batch_meter`` is given) raises a ``TypeError`` right here instead of
-    only in the slow-marked equivalence tests the default suite deselects."""
+    only in the slow-marked equivalence tests the default suite deselects.
+
+    Parametrized over ``--data_placement`` so BOTH driver loops stay under
+    driver-level test: 'device' is the HBM-resident branch, 'host' the
+    per-step H2D branch (the production path for memmap/over-budget
+    datasets — 'auto' alone would always resolve to 'device' on the tiny
+    in-RAM synthetic set and leave the host loop covered only at the
+    data-layer)."""
     supcon_driver, linear_driver, ce_driver = tiny_drivers
     from simclr_pytorch_distributed_tpu import config as config_lib
 
@@ -485,7 +493,7 @@ def test_all_drivers_flush_boundary_smoke(tmp_path, tiny_drivers):
         model="resnet10", dataset="synthetic", batch_size=32, epochs=1,
         learning_rate=0.05, cosine=True, save_freq=5, print_freq=2,
         size=SIZE, workdir=str(tmp_path / "sc"), seed=0, method="SimCLR",
-        telemetry="sync",
+        telemetry="sync", data_placement=placement,
     )
     supcon_driver.run(config_lib.finalize_supcon(cfg))
     assert any(r[0].startswith("info/") for r in RecordingTB.last_stream)
@@ -494,6 +502,7 @@ def test_all_drivers_flush_boundary_smoke(tmp_path, tiny_drivers):
             model="resnet10", dataset="synthetic", batch_size=32, epochs=1,
             learning_rate=0.1, size=SIZE, val_batch_size=40,
             workdir=str(tmp_path / sub), print_freq=2, telemetry="sync",
+            data_placement=placement,
         )
         driver.run(config_lib.finalize_linear(lcfg, prefix=prefix) if prefix
                    else config_lib.finalize_linear(lcfg))
