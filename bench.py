@@ -101,6 +101,12 @@ def _compile_with_flops(update, *example_args):
         return update, 0.0, 0.0
 
 
+# window length for the --data_placement window bench arm: the driver
+# default is 32, but the bench buffer only has to exercise the windowed
+# slice program (epoch_position % W), not a realistic window economy
+BENCH_WINDOW_BATCHES = 8
+
+
 def _setup_pretrain(mesh, batch, size, stem, data_placement="host"):
     """The headline workload: fused SimCLR pretrain step (recipe config).
 
@@ -109,10 +115,15 @@ def _setup_pretrain(mesh, batch, size, stem, data_placement="host"):
     ``[steps, batch, ...]`` buffers and slices its own batch at
     ``state.step % steps_per_epoch`` — the same program the drivers run
     under ``--data_placement device``, so the slice's cost (if any) is
-    measured with the existing methodology. Note bench's 'host' arm is
+    measured with the existing methodology. ``'window'`` benches the
+    WINDOWED step program the same way: a ``[BENCH_WINDOW_BATCHES, batch,
+    ...]`` resident window sliced at ``epoch_position % W`` — so the
+    windowed hot loop shows up in ``vs_baseline`` and the scaling story
+    next to the host and resident arms. Note bench's 'host' arm is
     already transfer-free (the same example batch every step — the
-    resident-batch FLOOR); this arm isolates the in-program slice, while
-    ``scripts/resident_ab.py`` measures the driver-loop transfer removal.
+    resident-batch FLOOR); these arms isolate the in-program slice, while
+    ``scripts/resident_ab.py`` / ``scripts/window_ab.py`` measure the
+    driver-loop transfer removal.
     """
     from simclr_pytorch_distributed_tpu.models import SupConResNet
     from simclr_pytorch_distributed_tpu.ops.augment import AugmentConfig
@@ -148,24 +159,29 @@ def _setup_pretrain(mesh, batch, size, stem, data_placement="host"):
     )
     update = make_fused_update(
         model, tx, schedule, step_cfg, AugmentConfig(size=size), mesh, state,
-        resident=data_placement == "device",
+        resident=data_placement != "host",
+        window_batches=(
+            BENCH_WINDOW_BATCHES if data_placement == "window" else None
+        ),
     )
 
     rng = np.random.default_rng(0)
-    if data_placement == "device":
-        # the drivers' resident layout: one full shuffled epoch on device,
-        # batch dim sharded (parallel/mesh.epoch_buffer_sharding)
+    if data_placement != "host":
+        # the drivers' resident layout: shuffled batches on device, batch
+        # dim sharded (parallel/mesh.epoch_buffer_sharding) — a full epoch
+        # for the resident store, one window for the window store
         from simclr_pytorch_distributed_tpu.parallel.mesh import (
             epoch_buffer_sharding,
         )
 
-        images = rng.integers(
-            0, 256, size=(steps_per_epoch, batch, size, size, 3),
-            dtype=np.uint8,
+        lead = (
+            BENCH_WINDOW_BATCHES if data_placement == "window"
+            else steps_per_epoch
         )
-        labels = rng.integers(
-            0, 10, size=(steps_per_epoch, batch)
-        ).astype(np.int32)
+        images = rng.integers(
+            0, 256, size=(lead, batch, size, size, 3), dtype=np.uint8,
+        )
+        labels = rng.integers(0, 10, size=(lead, batch)).astype(np.int32)
         sh_images = jax.device_put(images, epoch_buffer_sharding(mesh, 5))
         sh_labels = jax.device_put(labels, epoch_buffer_sharding(mesh, 2))
     else:
@@ -287,10 +303,13 @@ def main(argv=None):
              "docs/PERF.md)",
     )
     ap.add_argument(
-        "--data_placement", choices=["host", "device"], default="host",
+        "--data_placement", choices=["host", "device", "window"],
+        default="host",
         help="device = bench the resident-store step (full-epoch HBM buffer "
              "+ in-program slice, the --data_placement device driver "
-             "program) with the same methodology",
+             "program); window = the windowed-store step (one resident "
+             "window, in-program slice at epoch_position %% W) — same "
+             "methodology for all arms",
     )
     args = ap.parse_args(argv)
     if args.stem != "conv" and args.stage != "pretrain":

@@ -110,6 +110,13 @@ CONFIGS = {
     # device-kind gating. Seconds, so it rides the default list.
     "resident_ab": dict(model="resnet10", epochs=0, bar=None,
                         kind="resident_ab", dataset="synthetic"),
+    # round 8: the WINDOWED placement equivalence check
+    # (scripts/window_ab.py --smoke) — same convention as resident_ab:
+    # bit-identity binds on every device, the CPU-calibrated injected-delay
+    # timing claim pass-skips off-CPU with the reason on record. Seconds,
+    # so it rides the default list.
+    "window_ab": dict(model="resnet10", epochs=0, bar=None,
+                      kind="window_ab", dataset="synthetic"),
 }
 
 
@@ -169,31 +176,32 @@ def bench_gate_record(spec, rec, bar):
     return record
 
 
-def resident_gate_record(artifact):
-    """Gate decision for one resident_ab artifact (pure — tested directly).
+def _placement_gate_record(artifact, arm, value_key, extra_keys=()):
+    """Shared gate decision for the placement-equivalence A/Bs (pure —
+    tested through the two public wrappers).
 
-    ``equivalence_ok`` (byte-identical batches, host vs device placement)
-    binds EVERYWHERE — bit-identity is hardware-independent and is the
-    contract that lets accuracy ratchets carry across placements. The
-    timing claim (device arm at/near the no-transfer floor) binds only on
-    CPU, where the injected serialized-link delay is the calibrated proxy;
-    on an accelerator the real transfer economics differ, so the gate
-    pass-skips the timing with the reason on record (the bench gate's
-    device-kind convention).
+    ``equivalence_ok`` (byte-identical batches, host vs the ``arm``
+    placement) binds EVERYWHERE — bit-identity is hardware-independent and
+    is the contract that lets accuracy ratchets carry across placements.
+    The timing claim (the ``arm`` removing/amortizing the injected
+    per-step delay) binds only on CPU, where the serialized-link proxy is
+    calibrated; elsewhere the gate pass-skips the timing with the reason
+    on record (the bench gate's device-kind convention).
     """
     s = artifact["summary"]
     eq = artifact["equivalence"]
     record = {
-        "metric": "ratchet_resident_ab_equivalence",
-        "value": s["device_ms_per_step"],
+        "metric": f"ratchet_{arm}_ab_equivalence",
+        "value": s[value_key],
         "host_ms_per_step": s["host_ms_per_step"],
+        **{k: artifact[k] for k in extra_keys},
         "equivalence_ok": eq["equivalence_ok"],
         "steps_compared": eq["steps_compared"],
         "device": artifact["device"],
     }
     if not eq["equivalence_ok"]:
         record["ok"] = False
-        record["error"] = "device placement batches differ from host loader"
+        record["error"] = f"{arm} placement batches differ from host loader"
         return record
     if artifact["device"] != "cpu":
         record["ok"] = True
@@ -202,10 +210,26 @@ def resident_gate_record(artifact):
             f"calibrated for CPU only; equivalence still enforced"
         )
         return record
-    record["ok"] = bool(s["device_ms_per_step"] < s["host_ms_per_step"])
+    record["ok"] = bool(s[value_key] < s["host_ms_per_step"])
     if not record["ok"]:
-        record["error"] = "device arm not faster under injected H2D delay"
+        record["error"] = f"{arm} arm not faster under injected H2D delay"
     return record
+
+
+def resident_gate_record(artifact):
+    """Gate decision for one resident_ab artifact (the device arm at/near
+    the no-transfer floor; see _placement_gate_record)."""
+    return _placement_gate_record(artifact, "resident", "device_ms_per_step")
+
+
+def window_gate_record(artifact):
+    """Gate decision for one window_ab artifact (the window arm amortizing
+    the injected per-step delay to one per window, incl. the mid-epoch
+    window+slice-offset resume check; see _placement_gate_record)."""
+    return _placement_gate_record(
+        artifact, "window", "window_ms_per_step",
+        extra_keys=("window_batches",),
+    )
 
 
 class ConfigFailed(RuntimeError):
@@ -270,13 +294,14 @@ def run_config(name, spec, epochs, bar, args):
         print(json.dumps(record), flush=True)
         return record
 
-    if kind == "resident_ab":
-        # the placement-equivalence gate: byte-identity host vs device
-        # placement, plus the CPU-proxy timing claim (resident_gate_record)
-        ab_json = os.path.join(logs, "resident_ab.json")
-        ab_log = os.path.join(logs, "resident_ab.log")
+    if kind in ("resident_ab", "window_ab"):
+        # the placement-equivalence gates: byte-identity host vs device /
+        # windowed placement, plus the CPU-proxy timing claim
+        # (resident_gate_record / window_gate_record)
+        ab_json = os.path.join(logs, f"{kind}.json")
+        ab_log = os.path.join(logs, f"{kind}.log")
         run(
-            [sys.executable, "scripts/resident_ab.py", "--smoke",
+            [sys.executable, f"scripts/{kind}.py", "--smoke",
              "--json", ab_json],
             ab_log,
         )
@@ -284,8 +309,10 @@ def run_config(name, spec, epochs, bar, args):
             with open(ab_json) as f:
                 artifact = json.load(f)
         except (OSError, json.JSONDecodeError) as e:
-            raise ConfigFailed(f"resident_ab wrote no artifact: {e}") from e
-        record = resident_gate_record(artifact)
+            raise ConfigFailed(f"{kind} wrote no artifact: {e}") from e
+        gate = (resident_gate_record if kind == "resident_ab"
+                else window_gate_record)
+        record = gate(artifact)
         record["bar"] = bar
         record["log"] = ab_log
         print(json.dumps(record), flush=True)
@@ -388,8 +415,8 @@ def main():
             # summary line the CI parses
             if spec["kind"] == "bench":
                 metric = bench_metric_name(spec)
-            elif spec["kind"] == "resident_ab":
-                metric = "ratchet_resident_ab_equivalence"
+            elif spec["kind"] in ("resident_ab", "window_ab"):
+                metric = f"ratchet_{spec['kind']}_equivalence"
             else:
                 stage = "ce" if spec["kind"] == "ce" else "probe"
                 metric = f"ratchet_{spec['dataset']}_{stage}_top1_{name}"

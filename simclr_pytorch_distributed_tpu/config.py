@@ -121,11 +121,20 @@ class SupConConfig:
     telemetry: str = "async"
     # where training batches live (data/device_store.py): 'device' keeps the
     # uint8 dataset HBM-resident (one index upload + compiled shuffle-gather
-    # per epoch; the hot loop is dispatch-only — no per-step H2D); 'host' is
-    # the per-step device_put loop; 'auto' picks 'device' when the dataset is
-    # a plain in-RAM array within the HBM budget, else falls back to 'host'
-    # with a startup banner. Batch composition is bit-identical either way.
+    # per epoch; the hot loop is dispatch-only — no per-step H2D); 'window'
+    # streams a double-buffered window of permutation-ordered batches (one
+    # H2D per window — datasets that don't fit HBM, incl. memmap-backed
+    # folder trees); 'host' is the per-step device_put loop; 'auto' walks
+    # the device -> window -> host ladder against the budget. Batch
+    # composition is bit-identical in every placement.
     data_placement: str = "auto"
+    # windowed placement: batches per resident window; HBM cost is 2x one
+    # window (the training window + the prefetched shadow buffer)
+    data_window_batches: int = 32
+    # override the computed per-device placement budget, in MB (0 = 0.4x
+    # free memory_stats, with a fixed 4 GB fallback where stats are absent
+    # — untunable exactly where it matters without this)
+    device_budget_mb: int = 0
     # derived (finalize_supcon)
     warm_epochs: int = 10
     warmup_from: float = 0.01
@@ -163,6 +172,27 @@ def ngpu_arg(s: str):
         # flip the update direction — reject at parse, not mid-startup
         raise argparse.ArgumentTypeError(f"--ngpu must be positive, got {v}")
     return v
+
+
+def positive_int_arg(name: str):
+    """argparse type for flags that must be >= 1 (the --ngpu convention:
+    reject at parse, not mid-startup — these feed divisors and byte
+    budgets where 0/negatives fail far from the flag)."""
+
+    def parse(s: str) -> int:
+        try:
+            v = int(s)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"--{name} expects a positive integer, got {s!r}"
+            ) from None
+        if v <= 0:
+            raise argparse.ArgumentTypeError(
+                f"--{name} must be positive, got {v}"
+            )
+        return v
+
+    return parse
 
 
 def resolve_ngpu(ngpu, data_parallel: int) -> int:
@@ -275,11 +305,24 @@ def supcon_parser() -> argparse.ArgumentParser:
                    help="metric flush: background thread (zero sync on the "
                         "hot loop; NaN detection <=1 window late) or inline")
     p.add_argument("--data_placement", type=str, default=d.data_placement,
-                   choices=["host", "device", "auto"],
+                   choices=["host", "device", "window", "auto"],
                    help="training batches: 'device' = HBM-resident epoch "
-                        "buffer, dispatch-only hot loop; 'auto' falls back "
-                        "to 'host' (per-step H2D) for memmap-backed or "
-                        "over-budget datasets")
+                        "buffer; 'window' = double-buffered streaming "
+                        "window, one H2D per window (fits datasets HBM "
+                        "can't hold, incl. memmap-backed trees); 'auto' "
+                        "walks the device->window->host ladder; 'host' = "
+                        "per-step H2D")
+    p.add_argument("--data_window_batches",
+                   type=positive_int_arg("data_window_batches"),
+                   default=d.data_window_batches,
+                   help="windowed placement: batches per resident window "
+                        "(HBM cost = 2x one window: training + shadow)")
+    p.add_argument("--device_budget_mb",
+                   type=positive_int_arg("device_budget_mb"),
+                   default=d.device_budget_mb,
+                   help="override the per-device placement budget in MB "
+                        "(default: 0.4x free memory_stats, 4 GB fallback "
+                        "where the backend reports no stats)")
     return p
 
 
@@ -290,7 +333,10 @@ def validate_data_placement(dataset: str, data_placement: str) -> None:
     ``--mmap_threshold_mb``), which device residency refuses — whether THIS
     tree does is only known after the decode, so an explicit ``device``
     request is rejected up front rather than failing deep in setup; ``auto``
-    resolves against the decoded array (and falls back with a banner).
+    resolves against the decoded array (and walks the ladder with a
+    banner). Explicit ``window`` passes: the window store streams from a
+    memmap by construction (each window's gather reads only its own rows),
+    so the post-decode representation cannot invalidate the request.
     """
     if data_placement == "device" and dataset == "path":
         raise ValueError(
@@ -386,6 +432,8 @@ class LinearConfig:
     compile_cache: str = "auto"  # same semantics as the pretrain flag
     telemetry: str = "async"  # same semantics as the pretrain flag
     data_placement: str = "auto"  # same semantics as the pretrain flag
+    data_window_batches: int = 32  # same semantics as the pretrain flag
+    device_budget_mb: int = 0  # same semantics as the pretrain flag
     # derived
     n_cls: int = 10
     warm_epochs: int = 10
@@ -439,10 +487,19 @@ def linear_parser(ce: bool = False) -> argparse.ArgumentParser:
                    choices=["async", "sync"],
                    help="metric flush: background thread or inline")
     p.add_argument("--data_placement", type=str, default=d.data_placement,
-                   choices=["host", "device", "auto"],
+                   choices=["host", "device", "window", "auto"],
                    help="training batches: HBM-resident epoch buffer "
-                        "('device'), per-step H2D ('host'), or decide from "
-                        "the dataset size ('auto')")
+                        "('device'), double-buffered streaming window "
+                        "('window'), per-step H2D ('host'), or walk the "
+                        "device->window->host ladder ('auto')")
+    p.add_argument("--data_window_batches",
+                   type=positive_int_arg("data_window_batches"),
+                   default=d.data_window_batches,
+                   help="windowed placement: batches per resident window")
+    p.add_argument("--device_budget_mb",
+                   type=positive_int_arg("device_budget_mb"),
+                   default=d.device_budget_mb,
+                   help="override the per-device placement budget in MB")
     return p
 
 

@@ -34,16 +34,31 @@ accuracy ratchets carry over; mid-epoch resume is a slice-offset shift
 (``state.step`` restores from the checkpoint and the in-program position
 follows). Proven byte-for-byte by ``tests/test_device_store.py``.
 
-``resolve_data_placement`` implements the ``--data_placement`` contract:
-``auto`` degrades gracefully to host placement (one startup banner naming
-the reason) when the dataset is memmap-backed (``data/folder.py`` trees —
-resident placement would silently page the whole memmap into RAM) or
-exceeds the HBM budget; it never OOMs.
+Full residency is a small-dataset (CIFAR-geometry) luxury: the real SimCLR
+regime is 224x224 ImageNet-scale data that will never fit an HBM budget.
+:class:`WindowStore` generalizes the same dispatch-only hot loop to datasets
+that don't fit: the device trains from a resident window of
+epoch-permutation-ordered batches while a host prefetch thread stages the
+NEXT window into the shadow buffer, so the loop pays ONE H2D per window
+instead of one per step — and the permutation source is still the driver's
+own ``EpochLoader``, so the bit-identity contract (and its proof
+obligations: full epochs, mid-epoch resume, multi-process slicing) carries
+over unchanged. Proven by ``tests/test_window_store.py``.
+
+``resolve_data_placement`` implements the ``--data_placement`` contract as a
+three-way ladder: fully resident (``device``) when the dataset fits the
+budget, windowed (``window``) when ``2 x window_bytes`` fits — memmap-backed
+``data/folder.py`` trees are *windowable* (each window's host gather reads
+only that window's rows), not host-degraded — and ``host`` only as the true
+fallback (one startup banner naming the reason); it never OOMs, and the
+verdict is collective across processes because placement selects which
+collective programs a process runs.
 """
 
 from __future__ import annotations
 
 import logging
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -65,6 +80,17 @@ DEFAULT_BUDGET_BYTES = 4 << 30
 # model, optimizer state, activations, and the XLA allocator's slack own the
 # rest. Deliberately conservative: 'auto' must degrade, never OOM.
 BUDGET_FRACTION = 0.4
+# Batches per resident window when --data_window_batches is not given: large
+# enough that the per-window upload amortizes to noise (the A/B expectation
+# removes delay * (1 - 1/W) of a per-step penalty), small enough that
+# 2x window bytes stays far under any real HBM budget at 224x224 geometry.
+DEFAULT_WINDOW_BATCHES = 32
+
+
+def budget_override_bytes(mb) -> Optional[int]:
+    """``--device_budget_mb`` -> a ``resolve_data_placement`` budget override
+    in bytes; 0/None (the flag default) keeps the computed budget."""
+    return int(mb) << 20 if mb else None
 
 
 def dataset_nbytes(images: np.ndarray, labels: np.ndarray) -> int:
@@ -127,17 +153,43 @@ def resident_bytes_per_device(
     return dataset_nbytes(images, labels) + 2 * buffer_shard
 
 
+def windowed_bytes_per_device(
+    images: np.ndarray, labels: np.ndarray, global_batch_size: int,
+    data_parallel: int, window_batches: int,
+) -> int:
+    """Per-device HBM the WINDOW store will claim: 2x one window shard
+    (the resident window the device trains from plus the shadow buffer the
+    prefetch thread stages the next window into). Unlike residency, the
+    dataset itself never lands on device, so this bound is independent of
+    dataset size — the whole point of the ladder's middle rung.
+    """
+    n = len(images)
+    row_bytes = (
+        int(images.nbytes // max(1, n))
+        + int(np.asarray(labels).nbytes // max(1, n))
+    )
+    steps = max(1, n // global_batch_size)
+    w = min(max(1, window_batches), steps)  # the store clamps identically
+    shard = -(-w * global_batch_size * row_bytes // max(1, data_parallel))
+    return 2 * shard
+
+
 def _agree_across_processes(local_ok: bool) -> bool:
     """Collective AND of the per-process placement verdicts.
 
     The budget reads LOCAL ``memory_stats``, which can differ across hosts
     (fragmentation, co-resident allocations) — but placement selects which
     COLLECTIVE programs a process runs (the sharded per-epoch gather vs
-    per-step puts), so a split verdict would deadlock the pod at the first
-    epoch. Every process calls this exactly once during resolution (the
-    ``requested_global`` pattern, utils/preempt.py) and all act on the AND:
-    one over-budget host sends the whole job to host placement. Single
-    process short-circuits — no collective in the common case.
+    window uploads vs per-step puts), so a split verdict would deadlock
+    the pod at the first epoch. The invariant is that the CALL COUNT is
+    identical on every process during one resolution (the
+    ``requested_global`` pattern, utils/preempt.py): explicit placements
+    call it once, the 'auto' ladder once per rung it walks — which
+    matches because each rung's allgathered outcome is identical
+    everywhere, so all processes decide together whether the next rung's
+    collective runs. All act on the AND: one over-budget host sends the
+    whole job down the ladder. Single process short-circuits — no
+    collective in the common case.
     """
     if jax.process_count() == 1:
         return local_ok
@@ -156,76 +208,126 @@ def resolve_data_placement(
     global_batch_size: int,
     mesh,
     budget_bytes: Optional[int] = None,
+    window_batches: Optional[int] = None,
 ) -> str:
-    """The ``--data_placement`` decision, logged. Returns 'host' or 'device'.
+    """The ``--data_placement`` decision, logged. Returns 'host', 'device',
+    or 'window'.
 
     - ``host``: always honored (the pre-existing per-step H2D loop).
-    - ``device``: honored or a loud ``ValueError`` at startup — an explicit
-      request that cannot be satisfied must fail before the first step, not
-      OOM mid-run or silently degrade. On a multi-host job ANY process's
-      rejection raises on EVERY process (collective verdict): one host
-      erroring out while its peers build the store would strand the peers
-      in the store's collectives.
-    - ``auto``: 'device' when the dataset is a plain in-RAM array within the
-      budget ON EVERY PROCESS, else 'host' with a one-line startup banner
-      naming the reason (memmap-backed, the computed bytes vs budget, or a
-      peer's rejection).
+    - ``device``/``window``: honored or a loud ``ValueError`` at startup —
+      an explicit request that cannot be satisfied must fail before the
+      first step, not OOM mid-run or silently degrade. On a multi-host job
+      ANY process's rejection raises on EVERY process (collective verdict):
+      one host erroring out while its peers build the store would strand
+      the peers in the store's collectives.
+    - ``auto``: the three-way ladder, each rung a collective verdict —
+      'device' when the dataset is a plain in-RAM array within the budget
+      ON EVERY PROCESS, else 'window' when the double-buffered window
+      (``2 x window_bytes``; memmap-backed datasets qualify — each window's
+      host gather reads only that window's rows) fits everywhere, else
+      'host' with a one-line startup banner naming the reason.
     """
     if placement == "host":
         return "host"
-    if placement not in ("device", "auto"):
+    if placement not in ("device", "window", "auto"):
         raise ValueError(f"unknown data_placement {placement!r}")
 
     def reject(reason: str) -> str:
-        if placement == "device":
+        if placement != "auto":
             raise ValueError(
-                f"--data_placement device cannot be satisfied: {reason} — "
-                f"use 'auto' (falls back to host with a banner) or 'host'"
+                f"--data_placement {placement} cannot be satisfied: {reason}"
+                f" — use 'auto' (walks the device->window->host ladder with "
+                f"a banner) or 'host'"
             )
         logger.warning("data_placement auto -> host: %s", reason)
         return "host"
 
+    data_parallel = mesh.shape.get(DATA_AXIS, 1)
+    budget = device_budget_bytes() if budget_bytes is None else budget_bytes
+    w = window_batches or DEFAULT_WINDOW_BATCHES
+
+    # rung 1: full residency (the dataset itself on device)
     if _is_memmap_backed(images) or _is_memmap_backed(labels):
-        local_reason = (
+        resident_reason = (
             "dataset is memmap-backed (data/folder.py on-disk cache); "
             "device residency would page the whole tree into RAM/HBM"
         )
-        need = budget = None
+        need = None
     else:
-        data_parallel = mesh.shape.get(DATA_AXIS, 1)
         need = resident_bytes_per_device(
             images, labels, global_batch_size, data_parallel
         )
-        budget = device_budget_bytes() if budget_bytes is None else budget_bytes
-        local_reason = None if need <= budget else (
+        resident_reason = None if need <= budget else (
             f"dataset needs {need / 1e6:.1f} MB/device (replicated data + "
             f"2x epoch-buffer shard) > budget {budget / 1e6:.1f} MB"
         )
-    # every process reaches this exact point once, whatever its local
-    # verdict — the allgather schedules must match
-    ok_everywhere = _agree_across_processes(local_reason is None)
-    if local_reason is not None:
-        return reject(local_reason)
-    if not ok_everywhere:
-        return reject(
-            "a peer process rejected device placement (per-host free-memory "
-            "budgets differ); placement selects collective programs, so it "
-            "must agree across hosts"
-        )
-    logger.info(
-        "data_placement: device (%.1f MB/device resident: %.1f MB dataset "
-        "+ double-buffered epoch shard; budget %.1f MB)",
-        need / 1e6, dataset_nbytes(images, labels) / 1e6, budget / 1e6,
+    # rung 2: the double-buffered window (dataset stays on host)
+    window_need = windowed_bytes_per_device(
+        images, labels, global_batch_size, data_parallel, w
     )
-    return "device"
+    window_reason = None if window_need <= budget else (
+        f"double-buffered {w}-batch window needs {window_need / 1e6:.1f} "
+        f"MB/device > budget {budget / 1e6:.1f} MB"
+    )
+
+    def log_device() -> str:
+        logger.info(
+            "data_placement: device (%.1f MB/device resident: %.1f MB "
+            "dataset + double-buffered epoch shard; budget %.1f MB)",
+            need / 1e6, dataset_nbytes(images, labels) / 1e6, budget / 1e6,
+        )
+        return "device"
+
+    def log_window(why_not_resident: str) -> str:
+        logger.info(
+            "data_placement: window (%d batches/window, %.1f MB/device "
+            "double-buffered; budget %.1f MB; not fully resident: %s)",
+            w, window_need / 1e6, budget / 1e6, why_not_resident,
+        )
+        return "window"
+
+    peer = (
+        "a peer process rejected {0} placement (per-host free-memory "
+        "budgets differ); placement selects collective programs, so it "
+        "must agree across hosts"
+    )
+    if placement == "device":
+        # every process reaches this exact point once, whatever its local
+        # verdict — the allgather schedules must match
+        ok_everywhere = _agree_across_processes(resident_reason is None)
+        if resident_reason is not None:
+            return reject(resident_reason)
+        if not ok_everywhere:
+            return reject(peer.format("device"))
+        return log_device()
+    if placement == "window":
+        ok_everywhere = _agree_across_processes(window_reason is None)
+        if window_reason is not None:
+            return reject(window_reason)
+        if not ok_everywhere:
+            return reject(peer.format("window"))
+        return log_window(resident_reason or "explicit window request")
+    # auto: walk the ladder. Each rung is one matched collective point; the
+    # rung-1 result is identical on every process, so all processes agree
+    # on whether rung 2's collective runs at all.
+    if _agree_across_processes(resident_reason is None):
+        return log_device()
+    if _agree_across_processes(window_reason is None):
+        return log_window(resident_reason or peer.format("device"))
+    return reject(window_reason or peer.format("window"))
 
 
 def make_store(
-    placement: str, loader, mesh, budget_bytes: Optional[int] = None,
-) -> Optional["DeviceStore"]:
+    placement: str,
+    loader,
+    mesh,
+    budget_bytes: Optional[int] = None,
+    window_batches: Optional[int] = None,
+):
     """The drivers' one-call entry point: resolve ``--data_placement``
-    against the LOADER'S OWN arrays and geometry, build the store if the
-    verdict is 'device', else return ``None`` (the host loop).
+    against the LOADER'S OWN arrays and geometry, build the matching store
+    — :class:`DeviceStore` ('device'), :class:`WindowStore` ('window') —
+    or return ``None`` (the host loop).
 
     Resolving from ``loader.images``/``loader.labels`` (not the raw
     ``load_dataset`` arrays) matters: the loader may have copied a
@@ -235,9 +337,32 @@ def make_store(
     """
     placement = resolve_data_placement(
         placement, loader.images, loader.labels, loader.global_batch_size,
-        mesh, budget_bytes=budget_bytes,
+        mesh, budget_bytes=budget_bytes, window_batches=window_batches,
     )
-    return DeviceStore(loader, mesh) if placement == "device" else None
+    if placement == "device":
+        return DeviceStore(loader, mesh)
+    if placement == "window":
+        return WindowStore(
+            loader, mesh, window_batches or DEFAULT_WINDOW_BATCHES
+        )
+    return None
+
+
+def _validate_loader_geometry(loader, mesh, kind: str) -> None:
+    """The shared store-construction contract (DeviceStore and WindowStore
+    alike): a drop_last loader whose global batch shards evenly over the
+    mesh's data axis."""
+    if not loader.drop_last:
+        raise ValueError(
+            f"{kind} requires drop_last loaders (the training path);"
+            " ragged tails have no static step shape"
+        )
+    data_parallel = mesh.shape.get(DATA_AXIS, 1)
+    if loader.global_batch_size % data_parallel != 0:
+        raise ValueError(
+            f"global batch {loader.global_batch_size} not divisible by "
+            f"the mesh's {data_parallel}-way data axis"
+        )
 
 
 def epoch_index_matrix(loader, epoch: int) -> np.ndarray:
@@ -277,6 +402,10 @@ class DeviceStore:
     one-transfer-per-epoch contract through it, the MetricRing pattern).
     """
 
+    # the in-program slice axis is the whole epoch (drivers pass this to the
+    # update builders; WindowStore overrides with its window length)
+    window_batches: Optional[int] = None
+
     def __init__(
         self,
         loader,
@@ -284,17 +413,7 @@ class DeviceStore:
         *,
         index_put: Optional[Callable[[np.ndarray], jax.Array]] = None,
     ):
-        if not loader.drop_last:
-            raise ValueError(
-                "DeviceStore requires drop_last loaders (the training path);"
-                " ragged tails have no static step shape"
-            )
-        data_parallel = mesh.shape.get(DATA_AXIS, 1)
-        if loader.global_batch_size % data_parallel != 0:
-            raise ValueError(
-                f"global batch {loader.global_batch_size} not divisible by "
-                f"the mesh's {data_parallel}-way data axis"
-            )
+        _validate_loader_geometry(loader, mesh, "DeviceStore")
         self.loader = loader
         self.mesh = mesh
         self.steps_per_epoch = loader.steps_per_epoch
@@ -354,3 +473,205 @@ class DeviceStore:
             self._buffers = self._gather(self.images, self.labels, idx)
             self._cached_epoch = epoch
         return self._buffers
+
+    def batch_buffers(self, epoch: int, idx: int) -> Tuple[jax.Array, jax.Array]:
+        """The store API the driver loops consume (shared with
+        :class:`WindowStore`): the device buffers step ``idx`` of ``epoch``
+        slices its batch from. Here that is the whole cached epoch buffer —
+        the per-step position is derived on device from ``state.step``."""
+        del idx  # every step of the epoch reads the same resident buffers
+        return self.epoch_buffers(epoch)
+
+    def close(self) -> None:
+        """Release driver-owned resources (shared API with WindowStore);
+        the resident store holds no threads — nothing to do."""
+
+
+class WindowStore:
+    """Double-buffered streaming window: the dispatch-only hot loop for
+    datasets that don't fit in HBM.
+
+    The device trains from a resident ``[window_batches, batch, ...]``
+    window of epoch-permutation-ordered batches while the host prefetch
+    thread stages the NEXT window into the shadow buffer, so the hot loop
+    pays ONE H2D per window instead of one per step — and between window
+    boundaries it is exactly PR 5's dispatch-only loop (no host work, no
+    transfer, no sync). The swap at a boundary is a handle exchange: the
+    prefetched upload was dispatched asynchronously while the previous
+    window trained, so the caller never blocks on a landed transfer.
+
+    One permutation source: window ``w`` of epoch ``e`` is rows
+    ``[w*W, (w+1)*W)`` of :func:`epoch_index_matrix` — EXACTLY the driver's
+    ``EpochLoader`` permutation, drop_last-truncated, with process ``p``'s
+    column block of every row being that process's loader slice (the same
+    multi-host layout as the resident store, ``epoch_buffer_sharding``).
+    The short last window of an epoch is padded back to ``W`` batches with
+    rows the step never slices (the in-program position
+    ``epoch_position(step) % W`` stays below the tail length), so every
+    window shares ONE compiled step program. Mid-epoch resume is a window +
+    slice offset shift: the driver asks for ``batch_buffers(epoch,
+    start_step)``, which lands in window ``start_step // W``, and the
+    restored ``state.step`` positions the in-window slice.
+
+    The host gather for one window reads only that window's rows — and on
+    a pod, only THIS process's column block of them (``_stage``) — so on a
+    memmap-backed dataset (``data/folder.py``) the epoch streams through
+    the page cache window by window instead of paging the whole tree into
+    RAM, which is why the placement ladder marks memmap trees *windowable*
+    rather than host-degraded.
+
+    ``window_put`` is the injectable per-window upload, receiving the
+    process-local ``[W, B/process_count, ...]`` blocks (tests assert the
+    one-upload-per-window, window-sized transfer contract through it — the
+    ``index_put`` pattern). ``prefetch=False`` stages every window in the
+    caller's thread: deterministic upload ordering for tests and for the
+    serialized-link A/B proxy (``scripts/window_ab.py``), where overlap
+    would hide the modeled transfer.
+    """
+
+    def __init__(
+        self,
+        loader,
+        mesh,
+        window_batches: int = DEFAULT_WINDOW_BATCHES,
+        *,
+        window_put: Optional[Callable] = None,
+        prefetch: bool = True,
+    ):
+        _validate_loader_geometry(loader, mesh, "WindowStore")
+        if window_batches < 1:
+            raise ValueError(
+                f"window_batches must be >= 1, got {window_batches}"
+            )
+        self.loader = loader
+        self.mesh = mesh
+        self.steps_per_epoch = loader.steps_per_epoch
+        self.global_batch_size = loader.global_batch_size
+        self.window_batches = min(window_batches, loader.steps_per_epoch)
+        self.n_windows = -(-loader.steps_per_epoch // self.window_batches)
+        self._img_sharding = epoch_buffer_sharding(mesh, loader.images.ndim + 1)
+        self._lab_sharding = epoch_buffer_sharding(mesh, 2)
+        self._window_put = window_put or self._default_put
+        self._executor = (
+            ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="WindowStore-prefetch"
+            )
+            if prefetch else None
+        )
+        self._epoch_idx: Optional[Tuple[int, np.ndarray]] = None
+        self._current = None  # (epoch, window, (images, labels))
+        self._next = None  # (epoch, window, Future)
+
+    def _default_put(self, images: np.ndarray, labels: np.ndarray):
+        """Async H2D of one PROCESS-LOCAL window block under the
+        epoch-buffer layout (the ``shard_host_batch`` convention: plain
+        ``device_put`` single-process, global-array assembly from
+        process-local column blocks on a pod)."""
+        if jax.process_count() == 1:
+            return (
+                jax.device_put(images, self._img_sharding),
+                jax.device_put(labels, self._lab_sharding),
+            )
+        w = images.shape[0]
+        return (
+            jax.make_array_from_process_local_data(
+                self._img_sharding, images,
+                (w, self.global_batch_size) + images.shape[2:],
+            ),
+            jax.make_array_from_process_local_data(
+                self._lab_sharding, labels, (w, self.global_batch_size),
+            ),
+        )
+
+    def _index_rows(self, epoch: int, window: int) -> np.ndarray:
+        cached = self._epoch_idx
+        if cached is None or cached[0] != epoch:
+            # benign race with a stale prefetch job: worst case one
+            # recompute — the tuple swap below is atomic
+            cached = (epoch, epoch_index_matrix(self.loader, epoch))
+            self._epoch_idx = cached
+        w = self.window_batches
+        rows = cached[1][window * w:(window + 1) * w]
+        if rows.shape[0] < w:
+            # short epoch tail: pad back to the static [W, B] shape with
+            # rows the step never slices (epoch_position % W < tail length)
+            pad = np.repeat(rows[:1], w - rows.shape[0], axis=0)
+            rows = np.concatenate([rows, pad], axis=0)
+        return rows
+
+    def _stage(self, epoch: int, window: int):
+        """Host-gather one window's rows and start its (async) upload.
+
+        Only THIS process's column block of the window is gathered — on a
+        pod each process reads/copies exactly the 1/P of the window its
+        own devices will hold (a memmap-backed tree pages only those
+        rows), instead of materializing all peers' slices too."""
+        rows = self._index_rows(epoch, window)
+        per_proc = self.global_batch_size // self.loader.process_count
+        lo = self.loader.process_index * per_proc
+        local_rows = rows[:, lo:lo + per_proc]
+        images = np.ascontiguousarray(self.loader.images[local_rows])
+        labels = np.ascontiguousarray(
+            np.asarray(self.loader.labels)[local_rows].astype(np.int32)
+        )
+        return self._window_put(images, labels)
+
+    def batch_buffers(self, epoch: int, idx: int) -> Tuple[jax.Array, jax.Array]:
+        """The device buffers step ``idx`` of ``epoch`` slices its batch
+        from: the window containing ``idx``. Within a window this is the
+        cached handle pair (no host work); at a boundary the prefetched
+        shadow buffers are swapped in and the NEXT window's staging is
+        handed to the prefetch thread. A prefetch exception re-raises here,
+        on the training thread, where it can abort the step with a real
+        traceback (the EpochLoader worker convention)."""
+        window = idx // self.window_batches
+        cur = self._current
+        if cur is not None and cur[0] == epoch and cur[1] == window:
+            return cur[2]
+        nxt, self._next = self._next, None
+        if nxt is not None and nxt[0] == epoch and nxt[1] == window:
+            buffers = nxt[2].result()
+        else:
+            if nxt is not None and not nxt[2].cancel():
+                # a resume/rollback jump abandoned a staged window and
+                # cancel() cannot stop a RUNNING stage: wait it out
+                # (bounded — one window) and free its shard NOW, before
+                # staging the replacement. Letting it drain in the
+                # background would transiently hold a THIRD window shard
+                # on a device the ladder admitted at exactly 2x.
+                try:
+                    for arr in nxt[2].result():
+                        arr.delete()
+                except Exception:  # noqa: BLE001 — the stale stage itself
+                    pass  # failed: nothing landed, nothing to free
+            buffers = self._stage(epoch, window)
+        self._current = (epoch, window, buffers)
+        # Prefetch stays WITHIN the epoch: the first window of each epoch is
+        # staged in the caller's thread. That boundary is never hot — every
+        # driver drains telemetry collectively (and saves/validates) there —
+        # and within-epoch-only staging keeps the upload count per epoch
+        # exactly n_windows, which the transfer-count proofs pin.
+        if self._executor is not None and window + 1 < self.n_windows:
+            self._next = (
+                epoch, window + 1,
+                self._executor.submit(self._stage, epoch, window + 1),
+            )
+        return buffers
+
+    def close(self) -> None:
+        """Stop the prefetch worker and drop the staged shadow buffers.
+
+        Drivers call this on the way out (their ``finally``, next to the
+        EpochLoader ``batches.close()`` hygiene): without it a preemption
+        early-exit leaves a live non-daemon prefetch thread whose pending
+        window upload — which nothing will ever read — gets joined at
+        interpreter exit, stalling the exit-75 path. Queued-but-unstarted
+        jobs are cancelled; at most one in-flight stage finishes in the
+        background. The store degrades to synchronous staging if used
+        again after close (the prefetch=False path)."""
+        nxt, self._next = self._next, None
+        if nxt is not None:
+            nxt[2].cancel()
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None

@@ -136,7 +136,10 @@ def epoch_buffer_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
     step, so the per-step ``lax.dynamic_slice`` on the leading axis is a
     purely local slice — no communication in the hot loop — and on a
     multi-host mesh each process's devices hold exactly that process's
-    ``EpochLoader`` slice of every global batch."""
+    ``EpochLoader`` slice of every global batch. The windowed store's
+    ``[window_batches, batch, ...]`` buffers use the same convention (the
+    leading dim is just shorter), so one compiled step layout serves both
+    resident shapes."""
     if ndim < 2:
         raise ValueError(f"epoch buffers are [steps, batch, ...]; got ndim={ndim}")
     return NamedSharding(mesh, P(None, DATA_AXIS, *([None] * (ndim - 2))))

@@ -72,11 +72,15 @@ class CEState(struct.PyTreeNode):
     opt_state: Any
 
 
-def make_ce_steps(model, tx, aug_cfg, mesh, metric_ring=None, resident_steps=None):
+def make_ce_steps(
+    model, tx, aug_cfg, mesh, metric_ring=None, resident_steps=None,
+    window_batches=None,
+):
     """``metric_ring`` switches the train step to ring telemetry (see
     train/supcon.make_fused_update); ``None`` keeps the scalar-returning
     signature (bench.py). ``resident_steps`` switches the train step's data
-    args to the device-resident epoch buffers (jit_scalar_or_ring_step)."""
+    args to the device-resident epoch buffers, ``window_batches`` narrows
+    them to one streaming window (jit_scalar_or_ring_step)."""
     repl = replicated_sharding(mesh)
 
     def train_step(state: CEState, images_u8, labels, base_key):
@@ -121,7 +125,8 @@ def make_ce_steps(model, tx, aug_cfg, mesh, metric_ring=None, resident_steps=Non
         }
 
     train_jit = jit_scalar_or_ring_step(
-        train_step, metric_ring, mesh, resident_steps=resident_steps
+        train_step, metric_ring, mesh, resident_steps=resident_steps,
+        window_batches=window_batches,
     )
     eval_jit = jax.jit(
         eval_step,
@@ -183,14 +188,20 @@ def run(cfg: config_lib.LinearConfig):
 
     mean, std = stats_for(cfg.dataset)
     aug_cfg = AugmentConfig(size=cfg.size, mean=mean, std=std, color_ops=False)
-    # --data_placement (data/device_store.py): HBM-resident train set,
-    # dispatch-only hot loop; 'auto' degrades to the host loop with a banner
-    store = device_store.make_store(cfg.data_placement, loader, mesh)
+    # --data_placement (data/device_store.py): HBM-resident train set or a
+    # double-buffered streaming window, dispatch-only hot loop either way;
+    # 'auto' walks the device->window->host ladder with a banner
+    store = device_store.make_store(
+        cfg.data_placement, loader, mesh,
+        budget_bytes=device_store.budget_override_bytes(cfg.device_budget_mb),
+        window_batches=cfg.data_window_batches,
+    )
     # device-side metric ring + background flush (utils/telemetry.py)
     telemetry = TelemetrySession(cfg.print_freq, PROBE_METRIC_KEYS, cfg.telemetry)
     train_jit, eval_jit = make_ce_steps(
         model, tx, aug_cfg, mesh, metric_ring=telemetry.ring,
         resident_steps=steps_per_epoch if store is not None else None,
+        window_batches=None if store is None else store.window_batches,
     )
 
     start_epoch, start_step = 1, 0
@@ -254,15 +265,16 @@ def run(cfg: config_lib.LinearConfig):
             # oversized resume offset (changed geometry) must raise, not
             # silently complete a zero-step epoch
             loader.check_start_step(ss)
-            if store is not None:
-                epoch_images, epoch_labels = store.epoch_buffers(epoch)
-                batches = None
-            else:
-                batches = loader.epoch(epoch, start_step=ss)
+            batches = None if store is not None else loader.epoch(
+                epoch, start_step=ss
+            )
             try:
                 for idx in range(ss, steps_per_epoch):
                     gstep = (epoch - 1) * steps_per_epoch + idx  # == state.step
                     if batches is None:
+                        epoch_images, epoch_labels = store.batch_buffers(
+                            epoch, idx
+                        )
                         state, ring_buf = train_jit(
                             state, ring_buf, epoch_images, epoch_labels, base_key
                         )
@@ -344,6 +356,8 @@ def run(cfg: config_lib.LinearConfig):
     finally:
         preempt.uninstall()
         telemetry.close()
+        if store is not None:
+            store.close()  # stop the window prefetch worker on any exit
     wait_for_saves()
     logging.info("best accuracy: %.2f, accuracy5: %.2f", best_acc, best_acc5)
     tb.close()

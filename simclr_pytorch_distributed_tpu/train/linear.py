@@ -128,7 +128,9 @@ def topk_correct(logits: jax.Array, labels: jax.Array, ks=(1, 5)):
     return {k: jnp.sum(jnp.any(hit[:, :k], axis=1)) for k in ks}
 
 
-def jit_scalar_or_ring_step(step_fn, metric_ring, mesh, resident_steps=None):
+def jit_scalar_or_ring_step(
+    step_fn, metric_ring, mesh, resident_steps=None, window_batches=None
+):
     """Jit a ``(state, images_u8, labels, key) -> (state, metrics)`` train
     step for a probe-style driver. With ``metric_ring`` the step is wrapped
     to write its metrics into the donated device ring at ``state.step``
@@ -137,9 +139,12 @@ def jit_scalar_or_ring_step(step_fn, metric_ring, mesh, resident_steps=None):
     signature (bench.py). ``resident_steps`` (the loader's steps_per_epoch)
     switches the data arguments to the device-resident ``[steps, batch, ...]``
     epoch buffers (data/device_store.py): the program slices its own batch
-    at ``state.step % resident_steps`` and the buffers are NOT donated.
-    Shared by the probe and CE builders so the ring/resident wiring
-    (shardings + donation) cannot diverge between them."""
+    at ``state.step % resident_steps`` and the buffers are NOT donated;
+    ``window_batches`` additionally narrows them to one streaming window
+    (a WindowStore) by reducing the position modulo the window length (see
+    train/supcon.make_fused_update). Shared by the probe and CE builders so
+    the ring/resident wiring (shardings + donation) cannot diverge between
+    them."""
     repl = replicated_sharding(mesh)
     if resident_steps is None:
         data = (batch_sharding(mesh, 4), batch_sharding(mesh, 1))
@@ -148,9 +153,11 @@ def jit_scalar_or_ring_step(step_fn, metric_ring, mesh, resident_steps=None):
         data = (epoch_buffer_sharding(mesh, 5), epoch_buffer_sharding(mesh, 2))
 
         def sliced_step(state, epoch_images, epoch_labels, base_key):
+            pos = epoch_position(state.step, resident_steps)
+            if window_batches is not None:
+                pos = pos % window_batches
             images_u8, labels = slice_epoch_step(
-                epoch_images, epoch_labels,
-                epoch_position(state.step, resident_steps),
+                epoch_images, epoch_labels, pos
             )
             return step_fn(state, images_u8, labels, base_key)
 
@@ -176,7 +183,7 @@ def jit_scalar_or_ring_step(step_fn, metric_ring, mesh, resident_steps=None):
 
 def make_probe_steps(
     classifier, tx, encode, aug_cfg, eval_cfg, mesh, metric_ring=None,
-    resident_steps=None,
+    resident_steps=None, window_batches=None,
 ):
     """``metric_ring`` switches the train step to ring telemetry —
     ``(state, ring, images, labels, key) -> (state, ring)`` with the metrics
@@ -220,7 +227,8 @@ def make_probe_steps(
         return {"loss_sum": loss_sum, "top1": top1, "top5": top5, "n": jnp.sum(valid)}
 
     train_jit = jit_scalar_or_ring_step(
-        train_step, metric_ring, mesh, resident_steps=resident_steps
+        train_step, metric_ring, mesh, resident_steps=resident_steps,
+        window_batches=window_batches,
     )
     eval_jit = jax.jit(
         eval_step,
@@ -285,9 +293,14 @@ def run(cfg: config_lib.LinearConfig):
     )
     steps_per_epoch = len(loader)
     # --data_placement (data/device_store.py): 'device' keeps the train set
-    # HBM-resident — the probe step is SMALL, so the per-step H2D was a
-    # proportionally bigger slice of its loop than the pretrain driver's
-    store = device_store.make_store(cfg.data_placement, loader, mesh)
+    # HBM-resident, 'window' streams a double-buffered window — the probe
+    # step is SMALL, so the per-step H2D was a proportionally bigger slice
+    # of its loop than the pretrain driver's
+    store = device_store.make_store(
+        cfg.data_placement, loader, mesh,
+        budget_bytes=device_store.budget_override_bytes(cfg.device_budget_mb),
+        window_batches=cfg.data_window_batches,
+    )
 
     # encoder variables from the pretrain checkpoint (main_linear.py:125-142)
     dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
@@ -319,6 +332,7 @@ def run(cfg: config_lib.LinearConfig):
         classifier, tx, encode, aug_cfg, aug_cfg, mesh,
         metric_ring=telemetry.ring,
         resident_steps=steps_per_epoch if store is not None else None,
+        window_batches=None if store is None else store.window_batches,
     )
 
     tb = TBLogger(cfg.tb_folder, enabled=is_main_process())
@@ -370,15 +384,14 @@ def run(cfg: config_lib.LinearConfig):
                 telemetry.flush_boundary(ring_buf, consume, batch_meter=bt,
                                          step_hint=step_hint)
 
-            if store is not None:
-                epoch_images, epoch_labels = store.epoch_buffers(epoch)
-                batches = None
-            else:
-                batches = loader.epoch(epoch)
+            batches = None if store is not None else loader.epoch(epoch)
             try:
                 for idx in range(steps_per_epoch):
                     gstep = (epoch - 1) * steps_per_epoch + idx  # == state.step
                     if batches is None:
+                        epoch_images, epoch_labels = store.batch_buffers(
+                            epoch, idx
+                        )
                         state, ring_buf = train_jit(
                             state, ring_buf, epoch_images, epoch_labels, base_key
                         )
@@ -437,6 +450,8 @@ def run(cfg: config_lib.LinearConfig):
     finally:
         preempt.uninstall()
         telemetry.close()
+        if store is not None:
+            store.close()  # stop the window prefetch worker on any exit
 
     if best_params is not None:
         # beyond parity: persist the best probe head (the reference only

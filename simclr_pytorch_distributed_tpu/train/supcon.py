@@ -176,7 +176,7 @@ def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1
 
 def make_fused_update(
     model, tx, schedule, step_cfg, aug_cfg, mesh, state_example,
-    metric_ring=None, resident=False,
+    metric_ring=None, resident=False, window_batches=None,
 ):
     """augment(two crops) + train step as one GSPMD program.
 
@@ -201,6 +201,10 @@ def make_fused_update(
     ``state.step % steps_per_epoch`` (train/supcon_step.epoch_position) so
     the hot loop carries NO per-step host work or transfer. The buffers are
     deliberately NOT donated — every step of the epoch reads them.
+    ``window_batches`` (with ``resident=True``) narrows the buffers to one
+    streaming ``[window_batches, batch, ...]`` window (a WindowStore): the
+    in-program position becomes ``epoch_position % window_batches``, valid
+    because windows are aligned to multiples of the window length.
     """
     train_step = make_train_step(model, tx, schedule, step_cfg, mesh=mesh)
     repl = replicated_sharding(mesh)
@@ -215,6 +219,8 @@ def make_fused_update(
     def core(state: TrainState, images_arg, labels_arg, base_key):
         if resident:
             pos = epoch_position(state.step, step_cfg.steps_per_epoch)
+            if window_batches is not None:
+                pos = pos % window_batches
             images_u8, labels = slice_epoch_step(images_arg, labels_arg, pos)
         else:
             images_u8, labels = images_arg, labels_arg
@@ -272,15 +278,19 @@ def train_one_epoch(
     line up with the uninterrupted run). The ring is transient (never
     checkpointed); a fresh one is created here each epoch.
 
-    ``store`` (a data/device_store.DeviceStore) switches the epoch to the
-    device-resident data path: one index upload + compiled shuffle-gather at
-    epoch start, then every step dispatches against the SAME resident
-    buffers (``update_fn`` built with ``resident=True`` slices its own batch
-    at ``state.step % steps_per_epoch``) — no host gather, no per-step H2D.
-    The permutation source is the same ``loader``, so batch composition is
-    bit-identical either way; under resume the slice position follows the
-    restored step counter, so ``start_step`` only sets where this host loop
-    begins.
+    ``store`` (a data/device_store DeviceStore or WindowStore) switches the
+    epoch to the device-resident data path: every step dispatches against
+    the resident buffers ``store.batch_buffers(epoch, idx)`` returns — the
+    whole cached epoch for a DeviceStore (one index upload + compiled
+    shuffle-gather at epoch start), or the streaming window containing
+    ``idx`` for a WindowStore (one H2D per window, the next window staged
+    by its prefetch thread) — while ``update_fn`` (built with
+    ``resident=True``) slices its own batch from them on device. No host
+    gather, no per-step H2D either way. The permutation source is the same
+    ``loader``, so batch composition is bit-identical in every placement;
+    under resume the slice position follows the restored step counter, so
+    ``start_step`` only sets where this host loop begins (and which window
+    is fetched first).
 
     Each flush boundary also checks the preemption flag (utils/preempt.py)
     ON THE MAIN THREAD — the collective decision never depended on the D2H
@@ -349,11 +359,9 @@ def train_one_epoch(
     # oversized resume offset (changed geometry) must raise, not silently
     # complete a zero-step epoch
     loader.check_start_step(start_step)
-    if store is not None:
-        epoch_images, epoch_labels = store.epoch_buffers(epoch)
-        batches = None
-    else:
-        batches = loader.epoch(epoch, start_step=start_step)
+    batches = None if store is not None else loader.epoch(
+        epoch, start_step=start_step
+    )
     try:
         for idx in range(start_step, steps_per_epoch):
             if batches is not None:
@@ -363,6 +371,7 @@ def train_one_epoch(
             # per-step key = fold_in(base_key, state.step) INSIDE the program
             # (state.step == global_step); see make_fused_update
             if batches is None:
+                epoch_images, epoch_labels = store.batch_buffers(epoch, idx)
                 state, ring_buf = update_fn(
                     state, ring_buf, epoch_images, epoch_labels, base_key
                 )
@@ -452,11 +461,16 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         process_count=jax.process_count(),
     )
     steps_per_epoch = len(loader)
-    # --data_placement: 'device' keeps the uint8 dataset HBM-resident and the
-    # hot loop dispatch-only; 'auto' falls back to the host loop (with a
-    # startup banner naming the reason) for memmap-backed or over-budget
-    # datasets (data/device_store.py)
-    store = device_store.make_store(cfg.data_placement, loader, mesh)
+    # --data_placement: 'device' keeps the uint8 dataset HBM-resident,
+    # 'window' streams a double-buffered window (one H2D per window), and
+    # 'auto' walks the device->window->host ladder against the budget
+    # (--device_budget_mb overrides it) with a startup banner naming any
+    # degradation (data/device_store.py)
+    store = device_store.make_store(
+        cfg.data_placement, loader, mesh,
+        budget_bytes=device_store.budget_override_bytes(cfg.device_budget_mb),
+        window_batches=cfg.data_window_batches,
+    )
     model, schedule, tx, state, step_cfg = build(cfg, steps_per_epoch, mesh.size)
     logging.info("contrastive loss impl: %s", step_cfg.loss_impl)
 
@@ -492,10 +506,14 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         """The fused jitted update; ``lr_scale != 1`` (the NaN-rollback
         damping) rescales the whole schedule — optimizer chain structure is
         unchanged, so existing opt_states restore into it directly."""
+        store_kwargs = dict(
+            resident=store is not None,
+            window_batches=None if store is None else store.window_batches,
+        )
         if lr_scale == 1.0:
             return make_fused_update(
                 model, tx, schedule, step_cfg, aug_cfg, mesh, state,
-                metric_ring=telemetry.ring, resident=store is not None,
+                metric_ring=telemetry.ring, **store_kwargs,
             )
         scaled = lambda s, sc=lr_scale: schedule(s) * sc  # noqa: E731
         return make_fused_update(
@@ -505,7 +523,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
                 weight_decay=cfg.weight_decay, optimizer=cfg.optimizer,
             ),
             scaled, step_cfg, aug_cfg, mesh, state,
-            metric_ring=telemetry.ring, resident=store is not None,
+            metric_ring=telemetry.ring, **store_kwargs,
         )
 
     # failure policy (utils/guard.py): what a NonFiniteLossError does to the
@@ -656,10 +674,14 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         # On failure too: stop/flush an active profiler trace (it is most
         # valuable exactly when the epoch loop died), stop the telemetry
         # worker (close never raises — a pending flush error must not mask
-        # the real failure), and drain in-flight async checkpoint writes so
+        # the real failure), stop the window store's prefetch worker (a
+        # pending shadow-buffer upload nobody will read must not stall the
+        # exit-75 path), and drain in-flight async checkpoint writes so
         # finished payloads get their meta stamp.
         preempt.uninstall()
         telemetry.close()
+        if store is not None:
+            store.close()
         tracer.close()
         tb.close()
         wait_for_saves()

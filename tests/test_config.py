@@ -319,3 +319,53 @@ def test_data_placement_device_with_path_rejected_at_parse(tmp_path):
     assert parse_supcon(
         ["--data_placement", "auto", *path_args]
     ).data_placement == "auto"
+    # explicit 'window' x 'path' is FINE: the window store streams from a
+    # memmap by construction, so the post-decode representation cannot
+    # invalidate the request
+    assert parse_supcon(
+        ["--data_placement", "window", *path_args]
+    ).data_placement == "window"
+
+
+def test_window_placement_and_knobs_all_parsers(tmp_path):
+    """--data_placement window plus the --data_window_batches /
+    --device_budget_mb knobs on all three trainers' parsers; non-positive
+    values die at parse time (the --ngpu convention — they feed a slice
+    modulus and a byte budget)."""
+    cfg = parse_supcon(
+        ["--data_placement", "window", "--data_window_batches", "16",
+         "--device_budget_mb", "2048", "--workdir", str(tmp_path)]
+    )
+    assert cfg.data_placement == "window"
+    assert cfg.data_window_batches == 16 and cfg.device_budget_mb == 2048
+    for ce in (False, True):
+        lcfg = parse_linear(
+            ["--data_placement", "window", "--data_window_batches", "4",
+             "--device_budget_mb", "512", "--workdir", str(tmp_path)],
+            ce=ce,
+        )
+        assert lcfg.data_placement == "window"
+        assert lcfg.data_window_batches == 4
+        assert lcfg.device_budget_mb == 512
+    # defaults: window length 32, budget 0 = computed (0.4x free stats)
+    d = parse_supcon(["--workdir", str(tmp_path)])
+    assert d.data_window_batches == 32 and d.device_budget_mb == 0
+    for bad_flag in ("--data_window_batches", "--device_budget_mb"):
+        for bad in ("0", "-3", "x"):
+            with pytest.raises(SystemExit):
+                parse_supcon([bad_flag, bad, "--workdir", str(tmp_path)])
+            with pytest.raises(SystemExit):
+                parse_linear([bad_flag, bad, "--workdir", str(tmp_path)],
+                             ce=True)
+
+
+def test_budget_override_bytes_mapping():
+    """The flag-to-resolver plumbing: MB -> bytes, 0 -> None (computed)."""
+    from simclr_pytorch_distributed_tpu.data.device_store import (
+        budget_override_bytes,
+    )
+
+    assert budget_override_bytes(0) is None
+    assert budget_override_bytes(None) is None
+    assert budget_override_bytes(1) == 1 << 20
+    assert budget_override_bytes(2048) == 2048 << 20

@@ -177,7 +177,12 @@ def test_resolve_auto_falls_back_over_budget_with_banner(caplog):
         )
 
 
-def test_resolve_auto_falls_back_for_memmap(tmp_path):
+def test_resolve_never_makes_a_memmap_resident(tmp_path):
+    """A memmap-backed dataset disqualifies RESIDENCY on every path
+    (paging the whole tree into RAM/HBM): explicit 'device' raises, and
+    'auto' walks the ladder past the resident rung — to 'window' when the
+    double-buffered window fits (tests/test_window_store.py proves the
+    windowed contract), to 'host' when nothing does."""
     images, labels = _dataset()
     mm_path = tmp_path / "imgs.npy"
     np.save(mm_path, images)
@@ -186,6 +191,9 @@ def test_resolve_auto_falls_back_for_memmap(tmp_path):
     assert isinstance(mm, np.memmap)
     assert resolve_data_placement(
         "auto", mm, labels, 16, mesh, budget_bytes=1 << 30
+    ) == "window"
+    assert resolve_data_placement(
+        "auto", mm, labels, 16, mesh, budget_bytes=10
     ) == "host"
     with pytest.raises(ValueError, match="memmap"):
         resolve_data_placement(
@@ -193,14 +201,15 @@ def test_resolve_auto_falls_back_for_memmap(tmp_path):
         )
     # the PRODUCTION path: EpochLoader's ascontiguousarray strips the
     # np.memmap subclass into a plain ndarray VIEW (no copy — base chain
-    # still ends at the on-disk file); make_store must still refuse it,
-    # or residency would silently page the whole tree into RAM/HBM
+    # still ends at the on-disk file); make_store must still see through
+    # it, or residency would silently page the whole tree into RAM/HBM
     loader = EpochLoader(mm, labels, 16, base_seed=0)
     assert not isinstance(loader.images, np.memmap)
     assert device_store._is_memmap_backed(loader.images)
-    assert device_store.make_store(
+    store = device_store.make_store(
         "auto", loader, mesh, budget_bytes=1 << 30
-    ) is None
+    )
+    assert not isinstance(store, DeviceStore)
 
 
 def test_resident_bytes_accounting():
@@ -270,18 +279,23 @@ def test_resolve_placement_verdict_is_collective(monkeypatch, caplog):
             "auto", images, labels, 16, mesh, budget_bytes=1 << 30
         )
     assert got == "host"
-    assert calls == [True]  # our local verdict was 'fits'
+    # 'auto' walks BOTH ladder rungs as matched collective points (the
+    # rung-1 result is identical everywhere, so every process proceeds to
+    # rung 2 together); our local verdict was 'fits' at each
+    assert calls == [True, True]
     assert any("peer process" in r.message for r in caplog.records)
+    calls.clear()
     with pytest.raises(ValueError, match="peer process"):
         resolve_data_placement(
             "device", images, labels, 16, mesh, budget_bytes=1 << 30
         )
-    # the collective point is reached EXACTLY once per resolution, with the
-    # LOCAL verdict — a locally over-budget process still participates in
-    # the allgather (matched schedules) before taking its reject path
+    assert calls == [True]  # explicit 'device': one collective point
+    # each collective point is entered with the LOCAL verdict — a locally
+    # over-budget process still participates in the allgathers (matched
+    # schedules) before taking its reject path
     calls.clear()
     with caplog.at_level(logging.WARNING, logger="simclr_pytorch_distributed_tpu.data.device_store"):
         got = resolve_data_placement(
             "auto", images, labels, 16, mesh, budget_bytes=10
         )
-    assert got == "host" and calls == [False]
+    assert got == "host" and calls == [False, False]

@@ -484,6 +484,65 @@ def test_resident_ab_smoke_device_arm_removes_per_step_transfer(tmp_path):
     assert artifact["equivalence"]["equivalence_ok"]
 
 
+# --------------------------------------------------------------- window_ab
+
+
+def test_window_ab_build_output_schema():
+    """The committed docs/evidence/window_ab_r8.json schema, pinned without
+    running the measurement (the resident_ab/flush_ab pattern)."""
+    window_ab = _load("window_ab")
+    rounds = [
+        {"host": [250.0, 260.0], "window": [100.0, 98.0]},
+        {"host": [255.0, 245.0], "window": [101.0, 99.0]},
+    ]
+    eq = {"equivalence_ok": True, "steps_compared": 16, "epochs": 2,
+          "mid_epoch_resume_checked": True}
+    out = window_ab.build_output("cpu", 200.0, 8, 4, 2, rounds, eq)
+    assert out["metric"] == "window_ab_ms_per_step"
+    assert out["runs"] == rounds and out["equivalence"] == eq
+    assert out["h2d_delay_ms"] == 200.0 and out["steps_per_epoch"] == 8
+    assert out["window_batches"] == 4
+    s = out["summary"]
+    assert s["host_ms_per_step"] == 252.5  # median of the 4 host arms
+    assert s["window_ms_per_step"] == 99.5
+    assert s["transfer_removed_ms_per_step"] == 153.0
+    assert s["speedup"] == round(252.5 / 99.5, 3)
+    assert "ABBA" in out["arm_order"]
+    # the committed artifact carries this exact key set
+    with open(os.path.join(
+        os.path.dirname(SCRIPTS), "docs", "evidence", "window_ab_r8.json"
+    )) as f:
+        committed = json.load(f)
+    assert set(out) == set(committed)
+
+
+@pytest.mark.window
+def test_window_ab_smoke_window_arm_amortizes_per_step_transfer(tmp_path):
+    """Tier-1 guard on the committed-artifact path (the resident_ab smoke
+    pattern): the real script end-to-end on a tiny config — equivalence
+    pass (byte-identical batches incl. the window+offset mid-epoch resume),
+    both compiled arms, the ABBA loop, and the JSON artifact. Under the
+    injected serialized-link delay the window arm pays it once per WINDOW
+    instead of once per STEP, so most of the per-step delay must vanish."""
+    window_ab = _load("window_ab")
+    out_path = tmp_path / "window_ab.json"
+    out = window_ab.main([
+        "--smoke", "--rounds", "1", "--steps", "4", "--epochs", "1",
+        "--h2d_delay_ms", "120", "--json", str(out_path),
+    ])
+    assert out["equivalence"]["equivalence_ok"]
+    assert out["equivalence"]["steps_compared"] == 8  # 2 epochs x 4 steps
+    s = out["summary"]
+    assert s["window_ms_per_step"] < s["host_ms_per_step"]
+    # expected removal ~= delay * (1 - 1/window_batches) = 90 ms at these
+    # settings (W=4); require a third of the delay (generous vs 1-core
+    # contention noise)
+    assert s["transfer_removed_ms_per_step"] > out["h2d_delay_ms"] / 3
+    artifact = json.loads(out_path.read_text())
+    assert artifact["metric"] == "window_ab_ms_per_step"
+    assert artifact["equivalence"]["equivalence_ok"]
+
+
 # ------------------------------------------------------- ratchet bench gate
 
 
@@ -592,6 +651,37 @@ def test_ratchet_resident_gate_decision():
     assert r["ok"] and "calibrated" in r["skipped"]
     # on CPU the timing claim binds: the device arm must beat the host arm
     r = ratchet.resident_gate_record(art(host=150.0, dev=150.0))
+    assert not r["ok"] and "not faster" in r["error"]
+
+
+def test_ratchet_window_gate_decision():
+    """The WINDOWED placement equivalence gate rides the default config
+    list with the resident_ab conventions: bit-identity binds on EVERY
+    device, the CPU-calibrated injected-delay timing claim pass-skips
+    off-CPU with the reason on record."""
+    ratchet = _load("ratchet")
+    assert "window_ab" in ratchet.CONFIGS
+    assert ratchet.CONFIGS["window_ab"]["kind"] == "window_ab"
+
+    def art(device="cpu", host=250.0, win=100.0, eq=True):
+        return {
+            "summary": {"host_ms_per_step": host, "window_ms_per_step": win},
+            "equivalence": {"equivalence_ok": eq, "steps_compared": 16},
+            "window_batches": 4,
+            "device": device,
+        }
+
+    r = ratchet.window_gate_record(art())
+    assert r["ok"] and "skipped" not in r
+    assert r["metric"] == "ratchet_window_ab_equivalence"
+    # broken bit-identity fails EVERYWHERE, even where timing pass-skips
+    r = ratchet.window_gate_record(art(device="TPU v4", eq=False))
+    assert not r["ok"] and "differ" in r["error"]
+    # an accelerator: equivalence enforced, CPU-calibrated timing skipped
+    r = ratchet.window_gate_record(art(device="TPU v4", host=64.9, win=65.2))
+    assert r["ok"] and "calibrated" in r["skipped"]
+    # on CPU the timing claim binds: the window arm must beat the host arm
+    r = ratchet.window_gate_record(art(host=100.0, win=100.0))
     assert not r["ok"] and "not faster" in r["error"]
 
 

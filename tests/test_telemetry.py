@@ -471,7 +471,7 @@ def _tb_ab(run_fn):
     return streams
 
 
-@pytest.mark.parametrize("placement", ["host", "device"])
+@pytest.mark.parametrize("placement", ["host", "device", "window"])
 def test_all_drivers_flush_boundary_smoke(tmp_path, tiny_drivers, placement):
     """FAST guard on the driver<->flush_boundary contract: one sync-mode
     epoch through each REAL trainer. Sync telemetry runs every window job
@@ -480,12 +480,13 @@ def test_all_drivers_flush_boundary_smoke(tmp_path, tiny_drivers, placement):
     ``batch_meter`` is given) raises a ``TypeError`` right here instead of
     only in the slow-marked equivalence tests the default suite deselects.
 
-    Parametrized over ``--data_placement`` so BOTH driver loops stay under
-    driver-level test: 'device' is the HBM-resident branch, 'host' the
-    per-step H2D branch (the production path for memmap/over-budget
-    datasets — 'auto' alone would always resolve to 'device' on the tiny
-    in-RAM synthetic set and leave the host loop covered only at the
-    data-layer)."""
+    Parametrized over ``--data_placement`` so EVERY driver loop stays under
+    driver-level test: 'device' is the HBM-resident branch, 'window' the
+    streaming window-store branch (data_window_batches=2 forces real
+    mid-epoch window swaps in the 5-step epoch), 'host' the per-step H2D
+    branch (the production path for over-budget datasets — 'auto' alone
+    would always resolve to 'device' on the tiny in-RAM synthetic set and
+    leave the other loops covered only at the data-layer)."""
     supcon_driver, linear_driver, ce_driver = tiny_drivers
     from simclr_pytorch_distributed_tpu import config as config_lib
 
@@ -493,7 +494,7 @@ def test_all_drivers_flush_boundary_smoke(tmp_path, tiny_drivers, placement):
         model="resnet10", dataset="synthetic", batch_size=32, epochs=1,
         learning_rate=0.05, cosine=True, save_freq=5, print_freq=2,
         size=SIZE, workdir=str(tmp_path / "sc"), seed=0, method="SimCLR",
-        telemetry="sync", data_placement=placement,
+        telemetry="sync", data_placement=placement, data_window_batches=2,
     )
     supcon_driver.run(config_lib.finalize_supcon(cfg))
     assert any(r[0].startswith("info/") for r in RecordingTB.last_stream)
@@ -502,7 +503,7 @@ def test_all_drivers_flush_boundary_smoke(tmp_path, tiny_drivers, placement):
             model="resnet10", dataset="synthetic", batch_size=32, epochs=1,
             learning_rate=0.1, size=SIZE, val_batch_size=40,
             workdir=str(tmp_path / sub), print_freq=2, telemetry="sync",
-            data_placement=placement,
+            data_placement=placement, data_window_batches=2,
         )
         driver.run(config_lib.finalize_linear(lcfg, prefix=prefix) if prefix
                    else config_lib.finalize_linear(lcfg))
