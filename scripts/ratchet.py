@@ -117,6 +117,16 @@ CONFIGS = {
     # so it rides the default list.
     "window_ab": dict(model="resnet10", epochs=0, bar=None,
                       kind="window_ab", dataset="synthetic"),
+    # round 9: the flight-recorder smoke (docs/OBSERVABILITY.md) — one tiny
+    # trainer epoch with the recorder on, then scripts/trace_report.py over
+    # its events.jsonl. The gate binds on the attribution's internal
+    # consistency (trace_report_gate_record): main-thread phase spans
+    # non-overlapping and the table summing to the measured wall time —
+    # i.e. the recorder's track contract held through a REAL driver run on
+    # whatever device the gate runs on. Minutes, so it rides the default
+    # list.
+    "trace_report": dict(model="resnet10", epochs=1, bar=None,
+                         kind="trace_report", dataset="synthetic"),
 }
 
 
@@ -232,6 +242,46 @@ def window_gate_record(artifact):
     )
 
 
+def trace_report_gate_record(artifact):
+    """Gate decision for one trace_report artifact (pure — tested without
+    a driver run).
+
+    Binds on ``consistency.ok``: the attribution table sums to the measured
+    wall time with every phase non-negative and the main-thread phase spans
+    non-overlapping — the invariant that makes the table trustworthy. This
+    is hardware-independent (it is a property of the recorder's track
+    contract, not of any timing number), so unlike the bench bar it binds
+    on EVERY device. Phase presence is also checked: a driver run that
+    recorded no flush boundaries means the recorder was silently dead."""
+    rep = artifact["report"]
+    cons = rep["consistency"]
+    record = {
+        "metric": "ratchet_trace_report_attribution",
+        "value": cons["attributed_s"],
+        "wall_s": cons["wall_s"],
+        "steady_state_s": cons["steady_state_s"],
+        "phases": sorted(rep["phases"]),
+        "anomalies": rep["anomalies"],
+        "n_events": rep["n_events"],
+    }
+    if not cons["ok"]:
+        record["ok"] = False
+        record["error"] = (
+            "attribution inconsistent: overlapping main-thread phase spans "
+            "or oversubscribed wall time"
+        )
+        return record
+    if "flush" not in rep["phases"]:
+        record["ok"] = False
+        record["error"] = (
+            "no flush-boundary spans recorded: the recorder was not live "
+            "through the driver's epoch loop"
+        )
+        return record
+    record["ok"] = True
+    return record
+
+
 class ConfigFailed(RuntimeError):
     """One gated config could not produce a number; the others must still run."""
 
@@ -315,6 +365,46 @@ def run_config(name, spec, epochs, bar, args):
         record = gate(artifact)
         record["bar"] = bar
         record["log"] = ab_log
+        print(json.dumps(record), flush=True)
+        return record
+
+    if kind == "trace_report":
+        # the flight-recorder smoke: one tiny pretrain epoch with the
+        # recorder on, then the attribution report over its events.jsonl
+        pre_log = os.path.join(logs, "pretrain.log")
+        run(
+            [sys.executable, "main_supcon.py", "--dataset", dataset,
+             "--model", model, "--epochs", str(max(1, epochs)),
+             "--batch_size", "64", "--learning_rate", "0.05",
+             "--print_freq", "4", "--save_freq", "1",
+             "--flight_recorder", "on", "--workdir", args.workdir,
+             "--seed", str(args.seed), "--trial", trial],
+            pre_log,
+        )
+        models = os.path.join(args.workdir, f"{dataset}_models")
+        runs = [
+            os.path.join(models, d) for d in os.listdir(models)
+            if d.endswith(f"trial_{trial}")
+        ]
+        if not runs:
+            raise ConfigFailed(f"no run dir matching trial_{trial} in {models}")
+        run_dir = max(runs, key=os.path.getmtime)
+        events = os.path.join(run_dir, "events.jsonl")
+        report_json = os.path.join(logs, "trace_report.json")
+        report_log = os.path.join(logs, "trace_report.log")
+        run(
+            [sys.executable, "scripts/trace_report.py", "--events", events,
+             "--json", report_json],
+            report_log,
+        )
+        try:
+            with open(report_json) as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ConfigFailed(f"trace_report wrote no artifact: {e}") from e
+        record = trace_report_gate_record(artifact)
+        record["bar"] = bar
+        record["log"] = report_log
         print(json.dumps(record), flush=True)
         return record
 
@@ -415,6 +505,8 @@ def main():
             # summary line the CI parses
             if spec["kind"] == "bench":
                 metric = bench_metric_name(spec)
+            elif spec["kind"] == "trace_report":
+                metric = "ratchet_trace_report_attribution"
             elif spec["kind"] in ("resident_ab", "window_ab"):
                 metric = f"ratchet_{spec['kind']}_equivalence"
             else:

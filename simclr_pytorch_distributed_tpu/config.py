@@ -135,6 +135,22 @@ class SupConConfig:
     # free memory_stats, with a fixed 4 GB fallback where stats are absent
     # — untunable exactly where it matters without this)
     device_budget_mb: int = 0
+    # --- observability (docs/OBSERVABILITY.md) ---
+    # flight recorder (utils/tracing.py): host-boundary span/event log ->
+    # <run_dir>/events.jsonl + Chrome-trace trace.json; zero device
+    # syncs/transfers added (asserted mechanically in tier-1)
+    flight_recorder: str = "on"
+    # stall watchdog: if the flush boundary hasn't advanced in this many
+    # seconds, dump all thread stacks + a recorder snapshot to the run dir
+    # (a silent collective deadlock becomes an attributable artifact);
+    # 0 = off. Must comfortably exceed the first-step compile.
+    watchdog_secs: float = 0.0
+    # Prometheus /metrics sidecar (utils/prom.py TrainerGauges): step,
+    # last-boundary age, in-flight windows, pending checkpoint saves;
+    # 0 = off. Binds loopback by default — exposing an unauthenticated
+    # endpoint on all interfaces is an explicit choice (--metrics_host).
+    metrics_port: int = 0
+    metrics_host: str = "127.0.0.1"
     # derived (finalize_supcon)
     warm_epochs: int = 10
     warmup_from: float = 0.01
@@ -323,7 +339,30 @@ def supcon_parser() -> argparse.ArgumentParser:
                    help="override the per-device placement budget in MB "
                         "(default: 0.4x free memory_stats, 4 GB fallback "
                         "where the backend reports no stats)")
+    _add_observability_flags(p, d)
     return p
+
+
+def _add_observability_flags(p: argparse.ArgumentParser, d) -> None:
+    """The shared observability surface (docs/OBSERVABILITY.md): identical
+    on all three trainers, like --telemetry/--data_placement."""
+    p.add_argument("--flight_recorder", type=str, default=d.flight_recorder,
+                   choices=["on", "off"],
+                   help="host-boundary span/event recorder -> "
+                        "<run_dir>/events.jsonl + trace.json "
+                        "(utils/tracing.py); adds no device syncs")
+    p.add_argument("--watchdog_secs", type=float, default=d.watchdog_secs,
+                   help="stall watchdog: dump all thread stacks + a "
+                        "recorder snapshot when the flush boundary stalls "
+                        "this long (0 = off; set well above the first-step "
+                        "compile)")
+    p.add_argument("--metrics_port", type=int, default=d.metrics_port,
+                   help="Prometheus /metrics sidecar port (step, "
+                        "last-boundary age, in-flight windows, pending "
+                        "saves); 0 = off")
+    p.add_argument("--metrics_host", type=str, default=d.metrics_host,
+                   help="sidecar bind address (default loopback; set "
+                        "0.0.0.0 to let a remote Prometheus scrape)")
 
 
 def validate_data_placement(dataset: str, data_placement: str) -> None:
@@ -434,6 +473,15 @@ class LinearConfig:
     data_placement: str = "auto"  # same semantics as the pretrain flag
     data_window_batches: int = 32  # same semantics as the pretrain flag
     device_budget_mb: int = 0  # same semantics as the pretrain flag
+    # jax.profiler trace capture — previously pretrain-only, so the probe/CE
+    # stages could not capture an xplane window (utils/profiling.StepTracer)
+    trace_dir: str = ""
+    trace_start_step: int = 10
+    trace_steps: int = 10
+    flight_recorder: str = "on"  # same semantics as the pretrain flag
+    watchdog_secs: float = 0.0  # same semantics as the pretrain flag
+    metrics_port: int = 0  # same semantics as the pretrain flag
+    metrics_host: str = "127.0.0.1"  # same semantics as the pretrain flag
     # derived
     n_cls: int = 10
     warm_epochs: int = 10
@@ -500,6 +548,11 @@ def linear_parser(ce: bool = False) -> argparse.ArgumentParser:
                    type=positive_int_arg("device_budget_mb"),
                    default=d.device_budget_mb,
                    help="override the per-device placement budget in MB")
+    p.add_argument("--trace_dir", type=str, default=d.trace_dir,
+                   help="capture a jax.profiler trace into this dir")
+    p.add_argument("--trace_start_step", type=int, default=d.trace_start_step)
+    p.add_argument("--trace_steps", type=int, default=d.trace_steps)
+    _add_observability_flags(p, d)
     return p
 
 

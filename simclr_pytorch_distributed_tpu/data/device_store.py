@@ -70,6 +70,7 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
     epoch_buffer_sharding,
     replicated_sharding,
 )
+from simclr_pytorch_distributed_tpu.utils import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -195,9 +196,15 @@ def _agree_across_processes(local_ok: bool) -> bool:
         return local_ok
     from jax.experimental import multihost_utils
 
-    flags = multihost_utils.process_allgather(
-        np.asarray([local_ok], np.int32)
-    )
+    # a split placement verdict is the canonical silent-deadlock seed — the
+    # flight recorder keeps each host's local vote and the agreed outcome so
+    # a wedged pod's dumps show who voted what at which rung
+    with tracing.span(
+        "placement_decision", track="main:collective", local=bool(local_ok)
+    ):
+        flags = multihost_utils.process_allgather(
+            np.asarray([local_ok], np.int32)
+        )
     return bool(np.asarray(flags).all())
 
 
@@ -469,8 +476,11 @@ class DeviceStore:
         double-buffer bound in :func:`resident_bytes_per_device`).
         """
         if self._cached_epoch != epoch:
-            idx = self._index_put(epoch_index_matrix(self.loader, epoch))
-            self._buffers = self._gather(self.images, self.labels, idx)
+            # host-visible boundary (the ONE per-epoch upload + gather
+            # dispatch); the span records dispatch-side time only — no sync
+            with tracing.span("epoch_gather", track="main:data", epoch=epoch):
+                idx = self._index_put(epoch_index_matrix(self.loader, epoch))
+                self._buffers = self._gather(self.images, self.labels, idx)
             self._cached_epoch = epoch
         return self._buffers
 
@@ -606,15 +616,22 @@ class WindowStore:
         pod each process reads/copies exactly the 1/P of the window its
         own devices will hold (a memmap-backed tree pages only those
         rows), instead of materializing all peers' slices too."""
-        rows = self._index_rows(epoch, window)
-        per_proc = self.global_batch_size // self.loader.process_count
-        lo = self.loader.process_index * per_proc
-        local_rows = rows[:, lo:lo + per_proc]
-        images = np.ascontiguousarray(self.loader.images[local_rows])
-        labels = np.ascontiguousarray(
-            np.asarray(self.loader.labels)[local_rows].astype(np.int32)
-        )
-        return self._window_put(images, labels)
+        # runs on the prefetch thread normally, on the training thread for
+        # the first window of an epoch / a resume jump — its own non-main
+        # track either way (the main-thread blocking part is what
+        # window_swap measures in batch_buffers)
+        with tracing.span(
+            "window_stage", track="store:stage", epoch=epoch, window=window
+        ):
+            rows = self._index_rows(epoch, window)
+            per_proc = self.global_batch_size // self.loader.process_count
+            lo = self.loader.process_index * per_proc
+            local_rows = rows[:, lo:lo + per_proc]
+            images = np.ascontiguousarray(self.loader.images[local_rows])
+            labels = np.ascontiguousarray(
+                np.asarray(self.loader.labels)[local_rows].astype(np.int32)
+            )
+            return self._window_put(images, labels)
 
     def batch_buffers(self, epoch: int, idx: int) -> Tuple[jax.Array, jax.Array]:
         """The device buffers step ``idx`` of ``epoch`` slices its batch
@@ -629,22 +646,32 @@ class WindowStore:
         if cur is not None and cur[0] == epoch and cur[1] == window:
             return cur[2]
         nxt, self._next = self._next, None
-        if nxt is not None and nxt[0] == epoch and nxt[1] == window:
-            buffers = nxt[2].result()
-        else:
-            if nxt is not None and not nxt[2].cancel():
-                # a resume/rollback jump abandoned a staged window and
-                # cancel() cannot stop a RUNNING stage: wait it out
-                # (bounded — one window) and free its shard NOW, before
-                # staging the replacement. Letting it drain in the
-                # background would transiently hold a THIRD window shard
-                # on a device the ladder admitted at exactly 2x.
-                try:
-                    for arr in nxt[2].result():
-                        arr.delete()
-                except Exception:  # noqa: BLE001 — the stale stage itself
-                    pass  # failed: nothing landed, nothing to free
-            buffers = self._stage(epoch, window)
+        # window_swap is the main-thread BLOCKING part of the boundary —
+        # near-zero when the prefetch won the race, a full synchronous
+        # stage when it didn't (the number trace_report attributes to
+        # window staging)
+        with tracing.span(
+            "window_swap", track="main:data", epoch=epoch, window=window,
+            prefetched=bool(
+                nxt is not None and nxt[0] == epoch and nxt[1] == window
+            ),
+        ):
+            if nxt is not None and nxt[0] == epoch and nxt[1] == window:
+                buffers = nxt[2].result()
+            else:
+                if nxt is not None and not nxt[2].cancel():
+                    # a resume/rollback jump abandoned a staged window and
+                    # cancel() cannot stop a RUNNING stage: wait it out
+                    # (bounded — one window) and free its shard NOW, before
+                    # staging the replacement. Letting it drain in the
+                    # background would transiently hold a THIRD window shard
+                    # on a device the ladder admitted at exactly 2x.
+                    try:
+                        for arr in nxt[2].result():
+                            arr.delete()
+                    except Exception:  # noqa: BLE001 — the stale stage itself
+                        pass  # failed: nothing landed, nothing to free
+                buffers = self._stage(epoch, window)
         self._current = (epoch, window, buffers)
         # Prefetch stays WITHIN the epoch: the first window of each epoch is
         # staged in the caller's thread. That boundary is never hot — every

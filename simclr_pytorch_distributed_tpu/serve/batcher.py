@@ -54,6 +54,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from simclr_pytorch_distributed_tpu.utils import tracing
+
 
 class QueueFull(RuntimeError):
     """Bounded-queue backpressure: the submit was rejected, not queued."""
@@ -69,6 +71,11 @@ class _Request:
     n: int
     future: Future = field(default_factory=Future)
     deadline: Optional[float] = None  # clock() value; None = no timeout
+    # lifecycle stamps (batcher ``clock`` domain): submit -> dispatch ->
+    # completion; the per-bucket latency histogram and the flight
+    # recorder's per-request spans both read them
+    t_submit: float = 0.0
+    t_dispatch: float = 0.0
 
 
 class _EagerHandle:
@@ -112,6 +119,9 @@ class DynamicBatcher:
         clock: Callable[[], float] = time.monotonic,
         poll_interval: float = 0.002,
         start: bool = True,
+        latency=None,
+        bucket_fn: Optional[Callable[[int], int]] = None,
+        watchdog=None,
     ):
         if max_batch < 1 or max_queue < 1 or max_queue_images < 1:
             raise ValueError(
@@ -180,6 +190,20 @@ class DynamicBatcher:
             "max_batch_observed": 0,
             "max_inflight_observed": 0,
         }
+        # observability (utils/prom.py, utils/tracing.py; all optional):
+        # ``latency`` is a LatencyHistogram observed per REQUEST at
+        # completion, keyed by ``bucket_fn(n)`` (the engine's jit bucket,
+        # serve/server.py wires ``engine.bucket_for``) — timed with the same
+        # injectable ``clock`` as the deadlines, so /stats quantiles and the
+        # /metrics exposition are fake-clock-testable. ``watchdog`` is a
+        # tracing.StallWatchdog armed only while batches are in flight: the
+        # stall it detects is "the device owes us a completion and isn't
+        # delivering", never an idle server.
+        self._latency = latency
+        self._bucket_fn = bucket_fn
+        self._watchdog = watchdog
+        if watchdog is not None:
+            watchdog.disarm()  # idle until the first dispatch
         self._thread: Optional[threading.Thread] = None
         self._completer: Optional[threading.Thread] = None
         if start:
@@ -215,11 +239,13 @@ class DynamicBatcher:
             images = self._validate(images)
         if timeout_ms is None:
             timeout_ms = self._default_timeout_ms
+        now = self._clock()
         req = _Request(
             images=images,
             n=n,
-            deadline=(self._clock() + timeout_ms / 1e3)
+            deadline=(now + timeout_ms / 1e3)
             if timeout_ms is not None else None,
+            t_submit=now,
         )
         with self._cond:
             if self._closed:
@@ -253,6 +279,10 @@ class DynamicBatcher:
         (``start=False``) there is nobody to drain — queued requests are
         failed either way rather than leaving their futures hanging
         forever."""
+        if self._watchdog is not None:
+            # closing is expected silence: whatever is left in flight is
+            # about to be drained or failed, not stalled
+            self._watchdog.disarm()
         with self._cond:
             self._closed = True
             if not drain or self._thread is None:
@@ -394,8 +424,12 @@ class DynamicBatcher:
             batch[0].images if len(batch) == 1
             else np.concatenate([r.images for r in batch], axis=0)
         )
+        now = self._clock()
+        for req in batch:
+            req.t_dispatch = now
         try:
-            handle = self._dispatch_fn(images)
+            with tracing.span("dispatch", track="serve:dispatch", rows=total):
+                handle = self._dispatch_fn(images)
         except Exception as exc:  # noqa: BLE001 — delivered to every waiter
             with self._cond:
                 self._stats["errors"] += 1
@@ -409,7 +443,10 @@ class DynamicBatcher:
     def _finish(self, inflight: _Inflight) -> None:
         """Completion stage: block on the result and resolve the futures."""
         try:
-            emb = inflight.handle.result()
+            with tracing.span(
+                "complete", track="serve:complete", rows=inflight.total
+            ):
+                emb = inflight.handle.result()
         except Exception as exc:  # noqa: BLE001 — delivered to every waiter
             with self._cond:
                 self._stats["errors"] += 1
@@ -422,6 +459,7 @@ class DynamicBatcher:
             self._stats["max_batch_observed"] = max(
                 self._stats["max_batch_observed"], inflight.total
             )
+        now = self._clock()
         offset = 0
         for req in inflight.batch:
             rows = emb[offset:offset + req.n]
@@ -430,6 +468,18 @@ class DynamicBatcher:
                 req.future.set_result(rows)
             except InvalidStateError:
                 pass  # cancelled mid-flight
+            # per-request observability at the moment the answer exists:
+            # the histogram keys on the jit bucket the request padded into
+            # (the same axis the bench reports), the recorder span covers
+            # queue -> dispatch -> completion in the batcher's clock domain
+            key = self._bucket_fn(req.n) if self._bucket_fn else req.n
+            if self._latency is not None:
+                self._latency.observe(key, (now - req.t_submit) * 1e3)
+            tracing.record_span(
+                "request", "serve:request", req.t_submit, now,
+                n=req.n, bucket=int(key),
+                queue_ms=round((req.t_dispatch - req.t_submit) * 1e3, 3),
+            )
 
     def _dispatch(self, batch) -> None:
         """Synchronous dispatch+complete — the no-worker (``start=False``)
@@ -470,6 +520,12 @@ class DynamicBatcher:
                 self._stats["max_inflight_observed"] = max(
                     self._stats["max_inflight_observed"], len(self._inflight)
                 )
+                # arm only on the idle->busy edge: re-arming on every
+                # dispatch would keep pushing the deadline out while an
+                # earlier batch sits stuck — completion, not dispatch, is
+                # the progress the watchdog certifies
+                if self._watchdog is not None and len(self._inflight) == 1:
+                    self._watchdog.arm()
                 self._cond.notify_all()
         with self._cond:
             self._assembler_done = True
@@ -492,4 +548,11 @@ class DynamicBatcher:
                 self._occ_tick_locked()
                 self._inflight.popleft()
                 self._inflight_rows -= inflight.total
+                if self._watchdog is not None:
+                    # a completed batch is progress; an emptied window is
+                    # expected silence, not a stall
+                    if self._inflight:
+                        self._watchdog.beat()
+                    else:
+                        self._watchdog.disarm()
                 self._cond.notify_all()

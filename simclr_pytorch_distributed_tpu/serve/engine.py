@@ -81,6 +81,7 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
     put_batch_if_divisible,
     replicated_sharding,
 )
+from simclr_pytorch_distributed_tpu.utils import tracing
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 SERVE_DTYPES = ("fp32", "bf16")
@@ -409,6 +410,12 @@ class EmbeddingEngine:
             if hit_rows:
                 with self._lock:
                     self._stats["cache_hit_rows"] += hit_rows
+                # the cache leg of the request path: rows that never reach
+                # the device (a full-hit request has an empty miss set and
+                # dispatches nothing)
+                tracing.event(
+                    "cache_hits", track="serve:cache", rows=hit_rows, n=n
+                )
 
         chunks = []
         max_bucket = self.buckets[-1]

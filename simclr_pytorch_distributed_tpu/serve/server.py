@@ -6,8 +6,14 @@ Endpoints:
   ``{"images_b64": "<base64 raw bytes>", "shape": [n, h, w, 3]}``; optional
   ``"timeout_ms"``. Replies ``{"embeddings": [[...]], "dim": D, "n": N}``.
 - ``GET /healthz`` — liveness: ``{"status": "ok"}``.
-- ``GET /stats``  — engine/batcher/cache counters (the observability the
-  bench and operators read).
+- ``GET /stats``  — engine/batcher/cache counters plus per-bucket request
+  latency quantiles (p50/p95/p99 — the observability the bench and
+  operators read).
+- ``GET /metrics`` — Prometheus text exposition of the same counters and
+  latency histograms (utils/prom.py), so external scrapers see liveness
+  and saturation without parsing ``/stats`` JSON. The quantiles and the
+  histogram series are computed from the SAME clock-injectable
+  ``LatencyHistogram`` — the two views cannot drift.
 
 Status mapping makes the backpressure contract visible on the wire:
 ``QueueFull`` -> **503** (+ ``Retry-After``), a request/future timeout ->
@@ -23,6 +29,7 @@ import base64
 import binascii
 import json
 import logging
+import os
 import threading
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -68,12 +75,17 @@ def _decode_images(payload: dict) -> np.ndarray:
     raise ValueError("body must carry 'images' or 'images_b64'+'shape'")
 
 
-def make_handler(batcher: DynamicBatcher, stats_fn, *, result_timeout_s: float = 30.0):
+def make_handler(
+    batcher: DynamicBatcher, stats_fn, *, result_timeout_s: float = 30.0,
+    metrics_fn=None,
+):
     """Build the request-handler class bound to one batcher.
 
     ``stats_fn`` is any ``() -> dict`` (the engine's ``stats``, wrapped to
     merge batcher/cache views); keeping it a callable means the handler —
-    and its tests — need no engine at all.
+    and its tests — need no engine at all. ``metrics_fn`` is an optional
+    ``() -> str`` Prometheus text renderer behind ``GET /metrics`` (absent
+    = 404, the pre-observability surface).
     """
 
     class Handler(BaseHTTPRequestHandler):
@@ -94,6 +106,15 @@ def make_handler(batcher: DynamicBatcher, stats_fn, *, result_timeout_s: float =
                 self._reply(200, {"status": "ok"})
             elif self.path == "/stats":
                 self._reply(200, stats_fn())
+            elif self.path == "/metrics" and metrics_fn is not None:
+                body = metrics_fn().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -170,9 +191,12 @@ def make_handler(batcher: DynamicBatcher, stats_fn, *, result_timeout_s: float =
 
 def create_server(
     batcher: DynamicBatcher, stats_fn, host: str = "127.0.0.1", port: int = 8000,
-    result_timeout_s: float = 30.0,
+    result_timeout_s: float = 30.0, metrics_fn=None,
 ) -> ThreadingHTTPServer:
-    handler = make_handler(batcher, stats_fn, result_timeout_s=result_timeout_s)
+    handler = make_handler(
+        batcher, stats_fn, result_timeout_s=result_timeout_s,
+        metrics_fn=metrics_fn,
+    )
     server = ThreadingHTTPServer((host, port), handler)
     server.daemon_threads = True
     return server
@@ -184,11 +208,54 @@ def start_in_thread(server: ThreadingHTTPServer) -> threading.Thread:
     return t
 
 
-def combined_stats_fn(engine, batcher: DynamicBatcher):
+def combined_stats_fn(engine, batcher: DynamicBatcher, latency=None):
+    """The ``/stats`` payload: engine + batcher counters, and — when the
+    stack carries a ``LatencyHistogram`` — per-bucket p50/p95/p99 request
+    latency (the same histogram ``/metrics`` exposes, so the JSON and
+    Prometheus views agree by construction). The batcher section already
+    carries the time-weighted ``pipeline_occupancy``/``avg_inflight_depth``
+    gauges."""
+
     def stats():
-        return {"engine": engine.stats(), "batcher": batcher.stats()}
+        out = {"engine": engine.stats(), "batcher": batcher.stats()}
+        if latency is not None:
+            out["latency"] = latency.summary()
+        return out
 
     return stats
+
+
+def serve_metrics_fn(engine, batcher: DynamicBatcher, latency=None):
+    """Prometheus exposition for ``GET /metrics``: flat counters/gauges
+    from the engine and batcher stats (numeric leaves only — the nested
+    trace/bucket dicts become labeled series) plus the native cumulative
+    latency histograms."""
+    from simclr_pytorch_distributed_tpu.utils import prom
+
+    def metrics() -> str:
+        samples = []
+        es = engine.stats()
+        for key in ("requests", "images", "padded_rows", "cache_hit_rows"):
+            if key in es:
+                samples.append((f"serve_engine_{key}_total", None, es[key]))
+        for bucket, count in sorted(es.get("bucket_dispatches", {}).items()):
+            samples.append((
+                "serve_engine_bucket_dispatches_total",
+                {"bucket": bucket}, count,
+            ))
+        cache = es.get("cache") or {}
+        for key, value in sorted(cache.items()):
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                samples.append((f"serve_cache_{key}", None, value))
+        bs = batcher.stats()
+        for key, value in sorted(bs.items()):
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                samples.append((f"serve_batcher_{key}", None, value))
+        if latency is not None:
+            samples.extend(latency.samples("serve_request_latency_ms"))
+        return prom.render_prometheus(samples)
+
+    return metrics
 
 
 def build_parser():
@@ -236,6 +303,16 @@ def build_parser():
                    choices=["features", "projection"])
     p.add_argument("--cache_capacity", type=int, default=4096,
                    help="content-keyed LRU rows; 0 disables the cache")
+    p.add_argument("--watchdog_secs", type=float, default=0.0,
+                   help="stall watchdog: dump all thread stacks when a "
+                        "dispatched batch goes this long without a "
+                        "completion (armed only while batches are in "
+                        "flight); 0 = off")
+    p.add_argument("--events_jsonl", default="",
+                   help="flight-recorder output path: per-request spans "
+                        "(queue->dispatch->completion), cache events, and "
+                        "a Chrome-trace export beside it on shutdown "
+                        "(utils/tracing.py); empty = off")
     return p
 
 
@@ -248,6 +325,7 @@ def build_stack(args):
     """
     from simclr_pytorch_distributed_tpu.serve.cache import EmbeddingCache
     from simclr_pytorch_distributed_tpu.serve.engine import EmbeddingEngine
+    from simclr_pytorch_distributed_tpu.utils import prom, tracing
 
     buckets = tuple(int(b) for b in args.buckets.split(","))
     cache = EmbeddingCache(args.cache_capacity) if args.cache_capacity else None
@@ -262,6 +340,19 @@ def build_stack(args):
         engine = EmbeddingEngine.random_init(
             model_name=args.model, size=kwargs.get("img_size", 32), **kwargs
         )
+    watchdog = None
+    if getattr(args, "watchdog_secs", 0) and args.watchdog_secs > 0:
+        dump_dir = (
+            os.path.dirname(os.path.abspath(args.events_jsonl))
+            if getattr(args, "events_jsonl", "") else os.getcwd()
+        )
+        logging.info("serve stall watchdog: %.0fs deadline, dumps to %s",
+                     args.watchdog_secs, dump_dir)
+        watchdog = tracing.StallWatchdog(
+            args.watchdog_secs, dump_dir,
+            recorder=tracing.current(), name="serve",
+        )
+    latency = prom.LatencyHistogram()
     batcher = DynamicBatcher(
         # async dispatch: the assembler pipelines batches onto the device
         # while the completer materializes earlier ones
@@ -272,14 +363,32 @@ def build_stack(args):
         max_inflight_images=args.max_inflight_images,
         # geometry mismatches fail the submit (-> 400), never a worker batch
         validate=engine.validate_images,
+        # per-bucket request latency, keyed by the engine's jit bucket —
+        # feeds BOTH the /stats quantiles and the /metrics histograms
+        latency=latency, bucket_fn=engine.bucket_for, watchdog=watchdog,
     )
-    server = create_server(batcher, combined_stats_fn(engine, batcher),
-                           host=args.host, port=args.port)
+    server = create_server(
+        batcher, combined_stats_fn(engine, batcher, latency),
+        host=args.host, port=args.port,
+        metrics_fn=serve_metrics_fn(engine, batcher, latency),
+    )
+    # the watchdog thread outlives build_stack: hang it on the server so
+    # main()'s finally (and embedders reusing build_stack) can close it
+    server.stall_watchdog = watchdog
     return engine, batcher, server
 
 
 def main(argv=None):
+    from simclr_pytorch_distributed_tpu.utils import tracing
+
     args = build_parser().parse_args(argv)
+    recorder = None
+    if args.events_jsonl:
+        trace_path = os.path.splitext(args.events_jsonl)[0] + ".trace.json"
+        recorder = tracing.FlightRecorder(
+            args.events_jsonl, trace_path=trace_path
+        )
+        tracing.install(recorder)
     engine, batcher, server = build_stack(args)
     logging.info("serving %s embeddings (%s) on http://%s:%d",
                  engine.model.model_name, engine.dtype, args.host, args.port)
@@ -290,6 +399,11 @@ def main(argv=None):
     finally:
         server.shutdown()
         batcher.close()
+        if server.stall_watchdog is not None:
+            server.stall_watchdog.close()
+        tracing.uninstall()
+        if recorder is not None:
+            recorder.close()
 
 
 if __name__ == "__main__":

@@ -10,6 +10,7 @@ tracking — distributed over the mesh like the contrastive stage.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
 from typing import Any
@@ -54,6 +55,7 @@ from simclr_pytorch_distributed_tpu.train.linear import (
 )
 from simclr_pytorch_distributed_tpu.train.supcon import enable_compile_cache
 from simclr_pytorch_distributed_tpu.utils import preempt
+from simclr_pytorch_distributed_tpu.utils import tracing
 from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     resolve_resume_path,
     restore_checkpoint,
@@ -62,6 +64,8 @@ from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     wait_for_saves,
 )
 from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
+from simclr_pytorch_distributed_tpu.utils.obs import RunObservability
+from simclr_pytorch_distributed_tpu.utils.profiling import StepTracer
 from simclr_pytorch_distributed_tpu.utils.telemetry import TelemetrySession
 
 
@@ -196,8 +200,15 @@ def run(cfg: config_lib.LinearConfig):
         budget_bytes=device_store.budget_override_bytes(cfg.device_budget_mb),
         window_batches=cfg.data_window_batches,
     )
+    # observability stack (docs/OBSERVABILITY.md, utils/obs.py): flight
+    # recorder -> <save_folder>/events.jsonl (+ trace.json), stall
+    # watchdog on the flush boundary, optional Prometheus sidecar
+    obs = RunObservability(cfg, name="ce")
     # device-side metric ring + background flush (utils/telemetry.py)
-    telemetry = TelemetrySession(cfg.print_freq, PROBE_METRIC_KEYS, cfg.telemetry)
+    telemetry = TelemetrySession(
+        cfg.print_freq, PROBE_METRIC_KEYS, cfg.telemetry,
+        watchdog=obs.watchdog, gauges=obs.gauges,
+    )
     train_jit, eval_jit = make_ce_steps(
         model, tx, aug_cfg, mesh, metric_ring=telemetry.ring,
         resident_steps=steps_per_epoch if store is not None else None,
@@ -224,6 +235,13 @@ def run(cfg: config_lib.LinearConfig):
 
     tb = TBLogger(cfg.tb_folder, enabled=is_main_process())
     base_key = jax.random.key(cfg.seed + 1)
+    # windowed jax.profiler capture (utils/profiling.py) — previously
+    # reachable only from the supcon driver, so the CE stage could not
+    # capture an xplane window
+    tracer = StepTracer(
+        cfg.trace_dir, cfg.trace_start_step, cfg.trace_steps,
+        enabled=is_main_process(),
+    )
     # the best-accuracy watermark is RUN state: a resumed run that never
     # re-beats the pre-preemption peak must still report it (checkpoint
     # meta carries it, like the pretrain driver's rollback damping)
@@ -240,6 +258,7 @@ def run(cfg: config_lib.LinearConfig):
     try:
         for epoch in range(start_epoch, cfg.epochs + 1):
             t1 = time.time()
+            obs.set_epoch(epoch)
             losses, top1 = AverageMeter(), AverageMeter()
             ring_buf = telemetry.init_buffer(replicated_sharding(mesh))
 
@@ -269,22 +288,38 @@ def run(cfg: config_lib.LinearConfig):
                 epoch, start_step=ss
             )
             try:
+                epoch_span = tracing.span("epoch", track="main:epoch",
+                                          epoch=epoch)
+                epoch_span.__enter__()
                 for idx in range(ss, steps_per_epoch):
                     gstep = (epoch - 1) * steps_per_epoch + idx  # == state.step
+                    # first dispatch of the run carries trace+compile
+                    # (main:compile phase; see train/supcon.py)
+                    span = (
+                        tracing.span("first_step", track="main:compile",
+                                     step=gstep)
+                        if epoch == start_epoch and idx == ss
+                        else contextlib.nullcontext()
+                    )
                     if batches is None:
                         epoch_images, epoch_labels = store.batch_buffers(
                             epoch, idx
                         )
-                        state, ring_buf = train_jit(
-                            state, ring_buf, epoch_images, epoch_labels, base_key
-                        )
+                        with span:
+                            state, ring_buf = train_jit(
+                                state, ring_buf, epoch_images, epoch_labels,
+                                base_key
+                            )
                     else:
                         images_u8, labels = next(batches)
                         batch = shard_host_batch((images_u8, labels), mesh)
-                        state, ring_buf = train_jit(
-                            state, ring_buf, batch[0], batch[1], base_key
-                        )
+                        with span:
+                            state, ring_buf = train_jit(
+                                state, ring_buf, batch[0], batch[1], base_key
+                            )
                     telemetry.append(idx, gstep)
+                    if tracer is not None:
+                        tracer.step(gstep)
                     if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
                         submit_window(idx, ring_buf, gstep)
                         if idx + 1 < steps_per_epoch and preempt.requested_global():
@@ -298,6 +333,10 @@ def run(cfg: config_lib.LinearConfig):
                             # — sees complete metrics; the distinct exit code
                             # tells the launcher to re-run with --resume.
                             telemetry.drain_global(gstep)
+                            tracing.event(
+                                "preempt_exit", track="main:guard",
+                                epoch=epoch, step_in_epoch=idx + 1,
+                            )
                             preempt.emergency_save_and_exit(
                                 cfg.save_folder,
                                 f"preempt_epoch_{epoch}_step_{idx + 1}",
@@ -307,6 +346,7 @@ def run(cfg: config_lib.LinearConfig):
                                 cleanup=(tb.close, telemetry.close),
                             )
             finally:
+                epoch_span.__exit__(None, None, None)
                 if batches is not None:
                     batches.close()  # stop the prefetch worker on early exit
             # flush any short-epoch tail, then drain COLLECTIVELY ahead of
@@ -318,10 +358,11 @@ def run(cfg: config_lib.LinearConfig):
             logging.info("Train epoch %d, total time %.2f, accuracy:%.2f",
                          epoch, time.time() - t1, top1.avg)
 
-            val = run_validation(
-                eval_jit, eval_variables(state), test_data["images"],
-                test_data["labels"], cfg.val_batch_size, mesh,
-            )
+            with tracing.span("validation", track="main:eval", epoch=epoch):
+                val = run_validation(
+                    eval_jit, eval_variables(state), test_data["images"],
+                    test_data["labels"], cfg.val_batch_size, mesh,
+                )
             logging.info(" * Acc@1 %.3f, Acc@5 %.3f", val["top1"], val["top5"])
             if is_main_process():
                 tb.log_value("ce/train_loss", losses.avg, epoch)
@@ -344,6 +385,9 @@ def run(cfg: config_lib.LinearConfig):
                 # boundary preemption (collective decision): this epoch is
                 # persisted (by the scheduled save above, or a preempt_*
                 # save now), then the distinct exit
+                tracing.event(
+                    "preempt_exit", track="main:guard", epoch=epoch,
+                )
                 preempt.emergency_save_and_exit(
                     cfg.save_folder,
                     None if epoch % cfg.save_freq == 0
@@ -358,6 +402,13 @@ def run(cfg: config_lib.LinearConfig):
         telemetry.close()
         if store is not None:
             store.close()  # stop the window prefetch worker on any exit
+        tracer.close()
+        # drain in-flight async saves BEFORE the observability teardown
+        # (utils/obs.py ordering contract: the final checkpoint_commit span
+        # must land in the record, and the watchdog must still be watching
+        # if that drain wedges); the post-loop wait below is then a no-op
+        wait_for_saves()
+        obs.close()
     wait_for_saves()
     logging.info("best accuracy: %.2f, accuracy5: %.2f", best_acc, best_acc5)
     tb.close()

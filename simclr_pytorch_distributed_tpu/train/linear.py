@@ -20,6 +20,7 @@ frozen and CE is a per-example mean).
 from __future__ import annotations
 
 
+import contextlib
 import logging
 import time
 from typing import Any, Tuple
@@ -72,6 +73,9 @@ from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     save_classifier,
 )
 from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
+from simclr_pytorch_distributed_tpu.utils import tracing
+from simclr_pytorch_distributed_tpu.utils.obs import RunObservability
+from simclr_pytorch_distributed_tpu.utils.profiling import StepTracer
 from simclr_pytorch_distributed_tpu.utils.telemetry import TelemetrySession
 
 # ring columns for the probe/CE step metrics (ops/metrics.MetricRing)
@@ -324,10 +328,17 @@ def run(cfg: config_lib.LinearConfig):
     )
     mean, std = stats_for(cfg.dataset)
     aug_cfg = AugmentConfig(size=cfg.size, mean=mean, std=std, color_ops=False)
+    # observability stack (docs/OBSERVABILITY.md, utils/obs.py): flight
+    # recorder -> <save_folder>/events.jsonl (+ trace.json), stall
+    # watchdog on the flush boundary, optional Prometheus sidecar
+    obs = RunObservability(cfg, name="linear")
     # device-side metric ring + background flush (utils/telemetry.py): the
     # probe step is SMALL, so the per-window sync flush was a proportionally
     # bigger slice of its loop than the pretrain driver's
-    telemetry = TelemetrySession(cfg.print_freq, PROBE_METRIC_KEYS, cfg.telemetry)
+    telemetry = TelemetrySession(
+        cfg.print_freq, PROBE_METRIC_KEYS, cfg.telemetry,
+        watchdog=obs.watchdog, gauges=obs.gauges,
+    )
     train_jit, eval_jit = make_probe_steps(
         classifier, tx, encode, aug_cfg, aug_cfg, mesh,
         metric_ring=telemetry.ring,
@@ -337,6 +348,13 @@ def run(cfg: config_lib.LinearConfig):
 
     tb = TBLogger(cfg.tb_folder, enabled=is_main_process())
     base_key = jax.random.key(cfg.seed + 1)
+    # windowed jax.profiler capture (utils/profiling.py) — previously
+    # reachable only from the supcon driver, so the probe stage could not
+    # capture an xplane window
+    tracer = StepTracer(
+        cfg.trace_dir, cfg.trace_start_step, cfg.trace_steps,
+        enabled=is_main_process(),
+    )
     best_acc, best_acc5 = 0.0, 0.0
     best_params = None
 
@@ -356,6 +374,7 @@ def run(cfg: config_lib.LinearConfig):
     try:
         for epoch in range(1, cfg.epochs + 1):
             t1 = time.time()
+            obs.set_epoch(epoch)
             losses, top1, top5 = AverageMeter(), AverageMeter(), AverageMeter()
             bt = AverageMeter()
             bsz = cfg.batch_size
@@ -386,31 +405,48 @@ def run(cfg: config_lib.LinearConfig):
 
             batches = None if store is not None else loader.epoch(epoch)
             try:
-                for idx in range(steps_per_epoch):
-                    gstep = (epoch - 1) * steps_per_epoch + idx  # == state.step
-                    if batches is None:
-                        epoch_images, epoch_labels = store.batch_buffers(
-                            epoch, idx
+                with tracing.span("epoch", track="main:epoch", epoch=epoch):
+                    for idx in range(steps_per_epoch):
+                        gstep = (epoch - 1) * steps_per_epoch + idx  # == state.step
+                        # first dispatch of the run carries trace+compile
+                        # (main:compile phase; see train/supcon.py) — every
+                        # later step takes the nullcontext arm
+                        span = (
+                            tracing.span("first_step", track="main:compile",
+                                         step=gstep)
+                            if epoch == 1 and idx == 0
+                            else contextlib.nullcontext()
                         )
-                        state, ring_buf = train_jit(
-                            state, ring_buf, epoch_images, epoch_labels, base_key
-                        )
-                    else:
-                        images_u8, labels = next(batches)
-                        batch = shard_host_batch((images_u8, labels), mesh)
-                        state, ring_buf = train_jit(
-                            state, ring_buf, batch[0], batch[1], base_key
-                        )
-                    telemetry.append(idx, gstep)
-                    if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
-                        submit_window(idx, ring_buf, gstep)
-                        if preempt.requested_global():
-                            # collective decision (see train/supcon.py), on the
-                            # MAIN thread — independent of any in-flight flush:
-                            # all hosts leave the loop at the same boundary,
-                            # keeping the end-of-run barriers matched
-                            preempted = True
-                            break
+                        if batches is None:
+                            epoch_images, epoch_labels = store.batch_buffers(
+                                epoch, idx
+                            )
+                            with span:
+                                state, ring_buf = train_jit(
+                                    state, ring_buf, epoch_images,
+                                    epoch_labels, base_key
+                                )
+                        else:
+                            images_u8, labels = next(batches)
+                            batch = shard_host_batch((images_u8, labels), mesh)
+                            with span:
+                                state, ring_buf = train_jit(
+                                    state, ring_buf, batch[0], batch[1],
+                                    base_key
+                                )
+                        telemetry.append(idx, gstep)
+                        if tracer is not None:
+                            tracer.step(gstep)
+                        if (idx + 1) % cfg.print_freq == 0 or idx + 1 == steps_per_epoch:
+                            submit_window(idx, ring_buf, gstep)
+                            if preempt.requested_global():
+                                # collective decision (see train/supcon.py),
+                                # on the MAIN thread — independent of any
+                                # in-flight flush: all hosts leave the loop
+                                # at the same boundary, keeping the
+                                # end-of-run barriers matched
+                                preempted = True
+                                break
             finally:
                 if batches is not None:
                     batches.close()  # stop the prefetch worker on early exit
@@ -421,6 +457,7 @@ def run(cfg: config_lib.LinearConfig):
                 epoch * steps_per_epoch - 1,
             )
             if preempted:
+                tracing.event("preempt_exit", track="main:guard", epoch=epoch)
                 logging.warning(
                     "preempted (%s) during epoch %d: stopping the probe",
                     preempt.signal_name(), epoch,
@@ -435,10 +472,11 @@ def run(cfg: config_lib.LinearConfig):
                 tb.log_value("classifier/train_acc1", top1.avg, epoch)
                 tb.log_value("classifier/train_acc5", top5.avg, epoch)
 
-            val = run_validation(
-                eval_jit, state.params, test_data["images"], test_data["labels"],
-                cfg.val_batch_size, mesh,
-            )
+            with tracing.span("validation", track="main:eval", epoch=epoch):
+                val = run_validation(
+                    eval_jit, state.params, test_data["images"],
+                    test_data["labels"], cfg.val_batch_size, mesh,
+                )
             logging.info(" * Acc@1 %.3f, Acc@5 %.3f", val["top1"], val["top5"])
             if is_main_process():
                 tb.log_value("classifier/val_loss", val["loss"], epoch)
@@ -452,6 +490,10 @@ def run(cfg: config_lib.LinearConfig):
         telemetry.close()
         if store is not None:
             store.close()  # stop the window prefetch worker on any exit
+        tracer.close()
+        # no async saves in the probe (save_classifier is blocking), so
+        # the observability teardown has nothing to wait for
+        obs.close()
 
     if best_params is not None:
         # beyond parity: persist the best probe head (the reference only

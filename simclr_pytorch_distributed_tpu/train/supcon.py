@@ -19,6 +19,7 @@ Perf notes vs the reference hot loop:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import time
@@ -77,6 +78,8 @@ from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     wait_for_saves,
 )
 from simclr_pytorch_distributed_tpu.utils import preempt
+from simclr_pytorch_distributed_tpu.utils import tracing
+from simclr_pytorch_distributed_tpu.utils.obs import RunObservability
 from simclr_pytorch_distributed_tpu.utils.guard import (
     FailurePolicy,
     NonFiniteLossError,
@@ -255,7 +258,7 @@ TB_ITER_SCALARS = (  # reference per-iter scalars, main_supcon.py:327-333
 
 def train_one_epoch(
     epoch, loader, update_fn, state, mesh, base_key, cfg, tb, steps_per_epoch,
-    tracer=None, start_step=0, telemetry=None, store=None,
+    tracer=None, start_step=0, telemetry=None, store=None, compile_span=False,
 ):
     """One epoch (reference train(), main_supcon.py:242-351).
 
@@ -368,18 +371,34 @@ def train_one_epoch(
                 images_u8, labels = next(batches)
             data_time.update(time.time() - end)  # resident: nothing staged
             global_step = (epoch - 1) * steps_per_epoch + idx
+            # the ONE per-run instrumented dispatch: the first call's
+            # duration is dominated by trace+XLA compile (dispatch is async,
+            # so steady-state calls return in microseconds) — the flight
+            # recorder's main:compile phase, wrapped around ONLY the update
+            # call (the store's epoch_gather/window_swap record on main:data,
+            # and main:* phase spans never nest across tracks). Every later
+            # step takes the nullcontext arm: no span records in the hot
+            # loop.
+            span = (
+                tracing.span("first_step", track="main:compile",
+                             step=global_step)
+                if compile_span and idx == start_step
+                else contextlib.nullcontext()
+            )
             # per-step key = fold_in(base_key, state.step) INSIDE the program
             # (state.step == global_step); see make_fused_update
             if batches is None:
                 epoch_images, epoch_labels = store.batch_buffers(epoch, idx)
-                state, ring_buf = update_fn(
-                    state, ring_buf, epoch_images, epoch_labels, base_key
-                )
+                with span:
+                    state, ring_buf = update_fn(
+                        state, ring_buf, epoch_images, epoch_labels, base_key
+                    )
             else:
                 batch = shard_host_batch((images_u8, labels), mesh)
-                state, ring_buf = update_fn(
-                    state, ring_buf, batch[0], batch[1], base_key
-                )
+                with span:
+                    state, ring_buf = update_fn(
+                        state, ring_buf, batch[0], batch[1], base_key
+                    )
             telemetry.append((idx, global_step), global_step)
             if tracer is not None:
                 tracer.step(global_step)
@@ -497,10 +516,22 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         )
 
     aug_cfg = make_augment_config(cfg)
+    # Observability stack (docs/OBSERVABILITY.md, utils/obs.py): the flight
+    # recorder writes host-boundary spans to <save_folder>/events.jsonl
+    # (+ a Chrome-trace export on close), the stall watchdog turns a
+    # non-advancing flush boundary into stack-dump artifacts, and the
+    # optional Prometheus sidecar exposes liveness gauges. All host-only:
+    # the dispatch-only hot loop gains zero device syncs or transfers
+    # (asserted mechanically in tests/test_tracing.py).
+    obs = RunObservability(cfg, name="supcon")
     # One telemetry session per run: the device-side metric ring (written
     # inside the jitted update) + the background flush executor the epoch
-    # loop hands each print_freq window to (utils/telemetry.py).
-    telemetry = TelemetrySession(cfg.print_freq, METRIC_KEYS, cfg.telemetry)
+    # loop hands each print_freq window to (utils/telemetry.py). The
+    # watchdog/gauges ride its flush boundaries.
+    telemetry = TelemetrySession(
+        cfg.print_freq, METRIC_KEYS, cfg.telemetry,
+        watchdog=obs.watchdog, gauges=obs.gauges,
+    )
 
     def build_update(lr_scale: float):
         """The fused jitted update; ``lr_scale != 1`` (the NaN-rollback
@@ -576,12 +607,15 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
             # on-device copy (one HBM->HBM copy per epoch) is what the crash
             # handler can still save.
             backup = copy_state(state) if cfg.nan_guard else None
+            obs.set_epoch(epoch)
             try:
-                state, loss_avg, metrics, preempted_at = train_one_epoch(
-                    epoch, loader, update_fn, state, mesh, base_key, cfg, tb,
-                    steps_per_epoch, tracer=tracer, start_step=ss,
-                    telemetry=telemetry, store=store,
-                )
+                with tracing.span("epoch", track="main:epoch", epoch=epoch):
+                    state, loss_avg, metrics, preempted_at = train_one_epoch(
+                        epoch, loader, update_fn, state, mesh, base_key, cfg,
+                        tb, steps_per_epoch, tracer=tracer, start_step=ss,
+                        telemetry=telemetry, store=store,
+                        compile_span=(epoch == start_epoch),
+                    )
             except NonFiniteLossError:
                 # emergency save of the epoch-top state so --resume can
                 # restart after the root cause is addressed (failure
@@ -614,6 +648,10 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
                     step=backup.step + (target - int(backup.step)),
                     opt_state=realign_schedule_count(backup.opt_state, target),
                 )
+                tracing.event(
+                    "nan_rollback", track="main:guard", epoch=epoch,
+                    rollbacks=policy.rollbacks, lr_scale=policy.lr_scale,
+                )
                 update_fn = build_update(policy.lr_scale)
                 logging.warning(
                     "nan_policy=rollback (%d/%d): epoch %d skipped from its "
@@ -627,6 +665,10 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
                 # mid-epoch: blocking emergency save carrying the intra-epoch
                 # position, then the distinct exit code. run()'s finally
                 # still drains/uninstalls/closes on the way out.
+                tracing.event(
+                    "preempt_exit", track="main:guard", epoch=epoch,
+                    step_in_epoch=preempted_at,
+                )
                 preempt.emergency_save_and_exit(
                     cfg.save_folder,
                     f"preempt_epoch_{epoch}_step_{preempted_at}", state,
@@ -657,6 +699,9 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
                 # check: persist this epoch unless the scheduled save above
                 # already did (name=None skips the write but still drains
                 # the async save so its meta stamps), then exit.
+                tracing.event(
+                    "preempt_exit", track="main:guard", epoch=epoch,
+                )
                 preempt.emergency_save_and_exit(
                     cfg.save_folder,
                     None if epoch % cfg.save_freq == 0
@@ -685,6 +730,10 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         tracer.close()
         tb.close()
         wait_for_saves()
+        # observability teardown LAST (after the final wait_for_saves so
+        # the checkpoint_commit span lands in the record and the watchdog
+        # still watches a wedging drain) — the ordering lives on obs.close
+        obs.close()
     sync_processes("supcon_run_end")
     return state
 

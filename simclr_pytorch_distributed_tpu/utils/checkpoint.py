@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
 from simclr_pytorch_distributed_tpu.parallel.mesh import is_main_process
+from simclr_pytorch_distributed_tpu.utils import tracing
 
 META_FILE = "meta.json"
 
@@ -126,15 +127,29 @@ def _write_meta(path: str, meta: dict) -> None:
     os.replace(tmp, target)
 
 
+def pending_saves() -> int:
+    """In-flight async checkpoint writes (the sidecar's checkpoint gauge)."""
+    return len(_PENDING)
+
+
 def wait_for_saves() -> None:
     """Drain all in-flight background checkpoint writes; each checkpoint's
     meta.json marker is stamped as soon as ITS payloads commit."""
-    while _PENDING:
-        ckptrs, path, meta = _PENDING.pop(0)
-        for c in ckptrs:
-            c.wait_until_finished()
-            c.close()
-        _write_meta(path, meta)
+    if not _PENDING:
+        return
+    # the COMMIT side of an async save: submit (save_checkpoint) and commit
+    # are separate spans — the flight recorder distinguishes "the driver
+    # stalled serializing a save" from "the driver stalled waiting for an
+    # earlier save's disk write"
+    with tracing.span(
+        "checkpoint_commit", track="main:checkpoint", pending=len(_PENDING)
+    ):
+        while _PENDING:
+            ckptrs, path, meta = _PENDING.pop(0)
+            for c in ckptrs:
+                c.wait_until_finished()
+                c.close()
+            _write_meta(path, meta)
 
 
 def _restore_tree(path: str, abstract_tree):
@@ -170,42 +185,49 @@ def save_checkpoint(
     """
     if not block:
         # bound resources to one in-flight save: the previous async write
-        # (a save_freq of epochs ago) has long finished, so this is ~free
+        # (a save_freq of epochs ago) has long finished, so this is ~free.
+        # Deliberately OUTSIDE the submit span below — it records its own
+        # checkpoint_commit span, and main:* spans never nest (tracing.py).
         wait_for_saves()
-        # Snapshot before handing off: the caller's buffers are DONATED to
-        # the very next train step while the background write may still be
-        # serializing them. On backends where device memory IS host memory
-        # (CPU) orbax can read the reused buffer and persist a torn state a
-        # few steps AHEAD of the recorded epoch — observed as a kill -9
-        # resume restarting from a mid-later-epoch step
-        # (tests/test_fault_injection.py). One on-device copy decouples the
-        # save from donation on every backend.
-        state = jit_copy_tree(state)
-    path = os.path.abspath(os.path.join(save_folder, name))
-    c1 = _save_tree(
-        os.path.join(path, "model"),
-        {"params": state.params, "batch_stats": state.batch_stats},
-        block=block,
-    )
-    c2 = _save_tree(
-        os.path.join(path, "train"),
-        {
-            "opt_state": state.opt_state,
-            "step": state.step,
-            "record_norm_mean": state.record_norm_mean,
-        },
-        block=block,
-    )
-    meta = {
-        **(extra_meta or {}),
-        "epoch": epoch, "step_in_epoch": int(step_in_epoch),
-        "config": config or {},
-        "model_layout": MODEL_LAYOUT_VERSION,
-    }
-    if block:
-        _write_meta(path, meta)
-    else:
-        _PENDING.append(([c1, c2], path, meta))
+    with tracing.span(
+        "checkpoint_save", track="main:checkpoint", ckpt=name, block=block
+    ):
+        if not block:
+            # Snapshot before handing off: the caller's buffers are DONATED
+            # to the very next train step while the background write may
+            # still be serializing them. On backends where device memory IS
+            # host memory (CPU) orbax can read the reused buffer and persist
+            # a torn state a few steps AHEAD of the recorded epoch —
+            # observed as a kill -9 resume restarting from a
+            # mid-later-epoch step (tests/test_fault_injection.py). One
+            # on-device copy decouples the save from donation on every
+            # backend.
+            state = jit_copy_tree(state)
+        path = os.path.abspath(os.path.join(save_folder, name))
+        c1 = _save_tree(
+            os.path.join(path, "model"),
+            {"params": state.params, "batch_stats": state.batch_stats},
+            block=block,
+        )
+        c2 = _save_tree(
+            os.path.join(path, "train"),
+            {
+                "opt_state": state.opt_state,
+                "step": state.step,
+                "record_norm_mean": state.record_norm_mean,
+            },
+            block=block,
+        )
+        meta = {
+            **(extra_meta or {}),
+            "epoch": epoch, "step_in_epoch": int(step_in_epoch),
+            "config": config or {},
+            "model_layout": MODEL_LAYOUT_VERSION,
+        }
+        if block:
+            _write_meta(path, meta)
+        else:
+            _PENDING.append(([c1, c2], path, meta))
     return path
 
 

@@ -35,9 +35,19 @@ def setup_logging(
         root.addHandler(sh)
     if work_dir and is_main:
         os.makedirs(work_dir, exist_ok=True)
-        fh = logging.FileHandler(os.path.join(work_dir, "log-ing"))
-        fh.setFormatter(logging.Formatter(_FMT))
-        root.addHandler(fh)
+        target = os.path.abspath(os.path.join(work_dir, "log-ing"))
+        # dedup by handler TARGET, like the stream guard above: repeated
+        # setup_logging calls against the same run dir (launcher resume
+        # loops in one process, driver tests) must not stack FileHandlers —
+        # each stacked handler writes every line once more
+        if not any(
+            isinstance(h, logging.FileHandler)
+            and getattr(h, "baseFilename", None) == target
+            for h in root.handlers
+        ):
+            fh = logging.FileHandler(target)
+            fh.setFormatter(logging.Formatter(_FMT))
+            root.addHandler(fh)
 
 
 class TBLogger:

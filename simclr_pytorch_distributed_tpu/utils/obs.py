@@ -1,0 +1,86 @@
+"""RunObservability — the epoch drivers' one-call observability wiring.
+
+All three drivers want the identical stack (flight recorder + stall
+watchdog + Prometheus sidecar) with the identical lifecycle, and the
+teardown ORDER is a correctness property: the recorder must outlive the
+last ``wait_for_saves()`` (so the final ``checkpoint_commit`` span lands
+in the record) and the watchdog must still be watching while that drain
+can wedge. Keeping the wiring here — the ``device_store.make_store``
+convention — means the order cannot drift between drivers.
+
+Usage (see train/supcon.py)::
+
+    obs = RunObservability(cfg, name="supcon")
+    telemetry = TelemetrySession(..., watchdog=obs.watchdog,
+                                 gauges=obs.gauges)
+    try:
+        ...
+    finally:
+        ...
+        wait_for_saves()   # BEFORE obs.close(): the commit span records
+        obs.close()
+"""
+
+from __future__ import annotations
+
+import logging
+
+from simclr_pytorch_distributed_tpu.utils import prom, tracing
+from simclr_pytorch_distributed_tpu.utils.checkpoint import pending_saves
+
+logger = logging.getLogger(__name__)
+
+
+class RunObservability:
+    """Build (and later tear down, in the right order) the per-run
+    observability stack from a trainer config:
+
+    - ``recorder`` — installed as the module-level tracing recorder;
+      ``None`` under ``--flight_recorder off``;
+    - ``watchdog`` — a started :class:`tracing.StallWatchdog` beating on
+      the flush boundary (via ``TelemetrySession``); ``None`` unless
+      ``--watchdog_secs > 0``;
+    - ``gauges`` + the ``--metrics_port`` sidecar server; ``None`` when
+      the port is 0.
+    """
+
+    def __init__(self, cfg, name: str):
+        self.recorder = tracing.recorder_for_run(
+            cfg.save_folder, enabled=(cfg.flight_recorder != "off")
+        )
+        tracing.install(self.recorder)
+        self.watchdog = None
+        if cfg.watchdog_secs > 0:
+            self.watchdog = tracing.StallWatchdog(
+                cfg.watchdog_secs, cfg.save_folder, recorder=self.recorder,
+                name=name,
+            )
+        self.gauges = self.sidecar = None
+        if cfg.metrics_port:
+            self.gauges = prom.TrainerGauges()
+            self.gauges.register("checkpoint_pending_saves", pending_saves)
+            self.sidecar = prom.start_metrics_server(
+                cfg.metrics_port, self.gauges.prometheus_text,
+                host=getattr(cfg, "metrics_host", "127.0.0.1"),
+            )
+            logger.info(
+                "metrics sidecar on %s:%d",
+                *self.sidecar.server_address[:2],
+            )
+
+    def set_epoch(self, epoch: int) -> None:
+        if self.gauges is not None:
+            self.gauges.set(epoch=epoch)
+
+    def close(self) -> None:
+        """Teardown, last in the driver's ``finally`` (after the final
+        ``wait_for_saves()``): stop the watchdog/sidecar threads, then
+        uninstall and close the recorder — ``close()`` exports trace.json
+        and never raises."""
+        if self.watchdog is not None:
+            self.watchdog.close()
+        if self.sidecar is not None:
+            self.sidecar.shutdown()
+        tracing.uninstall()
+        if self.recorder is not None:
+            self.recorder.close()

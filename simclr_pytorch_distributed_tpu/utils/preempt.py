@@ -105,9 +105,19 @@ def requested_global() -> bool:
     import numpy as np
     from jax.experimental import multihost_utils
 
-    flags = multihost_utils.process_allgather(
-        np.asarray([_received is not None], np.int32)
-    )
+    from simclr_pytorch_distributed_tpu.utils import tracing
+
+    # the span that matters most in a pod post-mortem: when this collective
+    # deadlocks (a peer left the loop early), every surviving host's
+    # recorder shows its last completed preempt_decision and the watchdog's
+    # stack dump shows the allgather it is stuck in
+    with tracing.span(
+        "preempt_decision", track="main:collective",
+        local=bool(_received is not None),
+    ):
+        flags = multihost_utils.process_allgather(
+            np.asarray([_received is not None], np.int32)
+        )
     return bool(np.asarray(flags).any())
 
 

@@ -46,6 +46,7 @@ import time
 from typing import Callable, Optional, Sequence
 
 from simclr_pytorch_distributed_tpu.ops.metrics import MetricRing
+from simclr_pytorch_distributed_tpu.utils import tracing
 
 _STOP = object()
 
@@ -139,6 +140,12 @@ class FlushExecutor:
             while self._unfinished:
                 self._cv.wait()
 
+    def unfinished(self) -> int:
+        """Window jobs submitted but not yet completed (the in-flight-windows
+        gauge the trainer metrics sidecar exposes)."""
+        with self._cv:
+            return self._unfinished
+
     def poll(self) -> None:
         """Re-raise the first worker exception on the calling thread.
 
@@ -193,11 +200,20 @@ class TelemetrySession:
         keys: Sequence[str],
         mode: str = "async",
         device_get: Optional[Callable] = None,
+        watchdog=None,
+        gauges=None,
     ):
         self.ring = MetricRing(window, keys, device_get=device_get)
         self.executor = FlushExecutor(mode)
         self.mode = mode
         self._window_start = time.time()
+        # observability hooks (both host-only, both optional): the stall
+        # watchdog is beaten and the sidecar gauges stamped at the same
+        # deterministic flush boundaries the collective decisions use —
+        # "the boundary stopped advancing" is exactly the signal that means
+        # a wedged collective/device rather than ordinary slowness
+        self._watchdog = watchdog
+        self._gauges = gauges
 
     # ring pass-throughs used by the drivers
     def init_buffer(self, sharding=None):
@@ -222,7 +238,14 @@ class TelemetrySession:
         snapshot = jit_copy_tree(ring_buf)
 
         def job():
-            consume(self.ring.resolve(snapshot, pending))
+            # the D2H + consume side of the window, on whichever thread the
+            # executor runs it (its own track either way: under sync mode it
+            # nests inside the main-thread boundary span, which must not
+            # share a track with it — main:* tracks never nest)
+            with tracing.span(
+                "flush_job", track="telemetry:flush", steps=len(pending)
+            ):
+                consume(self.ring.resolve(snapshot, pending))
 
         self.executor.submit(job)
 
@@ -241,10 +264,18 @@ class TelemetrySession:
         of :meth:`check_failures_global` applied."""
         self.executor.wait_idle()
         self.check_failures_global(step_hint)
+        if self._watchdog is not None:
+            # a completed drain is progress: the epoch-end save that often
+            # follows must start with the full deadline
+            self._watchdog.beat()
 
     def start_window_clock(self) -> None:
         """Reset the boundary-to-boundary wall clock (call at epoch start)."""
         self._window_start = time.time()
+        if self._watchdog is not None:
+            # an epoch edge is progress too: the first window of an epoch
+            # must get the full deadline even after a long validation/save
+            self._watchdog.beat()
 
     def flush_boundary(
         self,
@@ -282,19 +313,37 @@ class TelemetrySession:
         mutating the meter, so a worker-side read would print window k+1's
         (possibly torn) numbers against window k's log line.
         """
-        if batch_meter is not None:
-            n_pending = self.pending_count()
-            if n_pending:
-                now = time.time()
-                batch_meter.update(
-                    (now - self._window_start) / n_pending, n=n_pending
+        # span covers the main-thread boundary work (meter + snapshot +
+        # queue) but NOT the collective failure observation below — that
+        # records on its own main:collective track, and main:* phase tracks
+        # must never nest across each other (the trace_report attribution
+        # invariant, utils/tracing.py)
+        with tracing.span(
+            "flush_boundary", track="main:flush", step=step_hint,
+            steps=self.pending_count(),
+        ):
+            if batch_meter is not None:
+                n_pending = self.pending_count()
+                if n_pending:
+                    now = time.time()
+                    batch_meter.update(
+                        (now - self._window_start) / n_pending, n=n_pending
+                    )
+                    self._window_start = now
+                bt = (batch_meter.val, batch_meter.avg)
+                self.submit_window(
+                    ring_buf, lambda fetched: consume(fetched, bt)
                 )
-                self._window_start = now
-            bt = (batch_meter.val, batch_meter.avg)
-            self.submit_window(ring_buf, lambda fetched: consume(fetched, bt))
-        else:
-            self.submit_window(ring_buf, consume)
+            else:
+                self.submit_window(ring_buf, consume)
         self.check_failures_global(step_hint)
+        # the boundary ADVANCED: beat the stall watchdog and stamp the
+        # sidecar gauges (both host-only; no device sync, no transfer)
+        if self._watchdog is not None:
+            self._watchdog.beat()
+        if self._gauges is not None:
+            self._gauges.beat(step_hint)
+            self._gauges.set(inflight_windows=self.executor.unfinished())
 
     def finish_epoch(self, submit_tail: Callable[[int], None], step_hint: int) -> None:
         """The drivers' shared epoch-end epilogue, ordering-critical like
@@ -353,12 +402,21 @@ class TelemetrySession:
             import numpy as np
             from jax.experimental import multihost_utils
 
-            codes = multihost_utils.process_allgather(
-                np.asarray([code], np.int32)
-            )
+            with tracing.span(
+                "failure_code_allgather", track="main:collective",
+                step=step_hint, local_code=code,
+            ):
+                codes = multihost_utils.process_allgather(
+                    np.asarray([code], np.int32)
+                )
             code = int(np.asarray(codes).max())
         if code == 0:
             return
+        # the recorder is exactly for this moment: a post-mortem must show
+        # WHICH boundary observed the failure and with what collective code
+        tracing.event(
+            "flush_failure", track="main:guard", code=code, step=step_hint
+        )
         from simclr_pytorch_distributed_tpu.utils.guard import NonFiniteLossError
 
         try:

@@ -710,3 +710,134 @@ def test_no_binaries_or_pycache_tracked():
     assert not offenders, offenders
     gitignore = open(os.path.join(repo, ".gitignore")).read()
     assert "*.so" in gitignore and "__pycache__/" in gitignore
+
+
+# ------------------------------------------------------------ trace_report
+
+
+def _span(name, track, ts, dur, **args):
+    e = {"name": name, "track": track, "ph": "X", "ts": ts, "dur": dur}
+    if args:
+        e["args"] = args
+    return e
+
+
+def _instant(name, track, ts, **args):
+    e = {"name": name, "track": track, "ph": "i", "ts": ts}
+    if args:
+        e["args"] = args
+    return e
+
+
+def _good_events():
+    """A consistent synthetic run: wall 100s, phases partitioning part of
+    it, the rest steady-state."""
+    return [
+        _instant("run_start", "events", 0.0),
+        _span("epoch", "main:epoch", 0.0, 100.0, epoch=1),  # envelope
+        _span("first_step", "main:compile", 1.0, 40.0),
+        _span("epoch_gather", "main:data", 0.2, 0.5),
+        _span("flush_boundary", "main:flush", 50.0, 2.0),
+        _span("flush_boundary", "main:flush", 60.0, 2.0),
+        _span("flush_boundary", "main:flush", 70.0, 2.0),
+        _span("checkpoint_save", "main:checkpoint", 90.0, 5.0),
+        _span("flush_job", "telemetry:flush", 50.5, 8.0),  # other thread
+        _instant("run_end", "events", 100.0),
+    ]
+
+
+def test_trace_report_attribution_partitions_wall(tmp_path):
+    tr = _load("trace_report")
+    report = tr.build_report(_good_events())
+    cons = report["consistency"]
+    assert cons["wall_s"] == pytest.approx(100.0)
+    # compile 40 + data 0.5 + flush 6 + checkpoint 5 = 51.5 attributed
+    assert cons["attributed_s"] == pytest.approx(51.5)
+    assert cons["steady_state_s"] == pytest.approx(48.5)
+    assert cons["monotone_ok"] and cons["nonnegative_ok"] and cons["ok"]
+    assert set(report["phases"]) == {"compile", "data", "flush", "checkpoint"}
+    assert report["phases"]["flush"]["count"] == 3
+    assert report["phases"]["flush"]["mean_ms"] == pytest.approx(2000.0)
+    # shares + steady share sum to 1
+    total = sum(p["share"] for p in report["phases"].values())
+    assert total + report["steady_state"]["share"] == pytest.approx(1.0, abs=1e-3)
+    # the epoch envelope and the telemetry-thread job are NOT attributed
+    assert "epoch" not in report["phases"]
+    # compile at 40% of wall stays under the 50% advisory bar
+    assert not any(a["phase"] == "compile" for a in report["anomalies"])
+
+
+def test_trace_report_flags_overlapping_spans():
+    tr = _load("trace_report")
+    events = _good_events() + [
+        # overlaps the 50.0-52.0 flush boundary ON another main track:
+        # main-thread phases may never overlap across tracks either
+        _span("checkpoint_save", "main:checkpoint", 51.0, 3.0),
+    ]
+    report = tr.build_report(events)
+    assert not report["consistency"]["monotone_ok"]
+    assert not report["consistency"]["ok"]
+
+
+def test_trace_report_anomaly_flags_and_event_findings():
+    tr = _load("trace_report")
+    events = [
+        _span("first_step", "main:compile", 0.0, 80.0),  # 80% of wall
+        _span("flush_boundary", "main:flush", 90.0, 1.0),
+        _instant("stall_detected", "watchdog", 95.0, dump=1),
+        _instant("nan_rollback", "main:guard", 96.0, epoch=3),
+        _instant("end", "events", 100.0),
+    ]
+    report = tr.build_report(events)
+    flags = {a["phase"]: a["flag"] for a in report["anomalies"]}
+    assert "compile" in flags  # 80% > 50% advisory bar
+    joined = " ".join(a["flag"] for a in report["anomalies"])
+    assert "stall watchdog fired" in joined and "NaN rollback" in joined
+
+
+def test_trace_report_empty_events_raise():
+    tr = _load("trace_report")
+    with pytest.raises(ValueError):
+        tr.build_report([])
+
+
+def test_trace_report_cli_writes_artifact(tmp_path):
+    tr = _load("trace_report")
+    events_path = tmp_path / "events.jsonl"
+    with open(events_path, "w") as f:
+        for e in _good_events():
+            f.write(json.dumps(e) + "\n")
+    out = tmp_path / "report.json"
+    rc = tr.main(["--events", str(events_path), "--json", str(out)])
+    assert rc == 0
+    artifact = json.load(open(out))
+    assert artifact["schema"] == "trace_report/v1"
+    assert artifact["report"]["consistency"]["ok"]
+    # the rendered table reached stdout is covered by rc; pin the artifact
+    # keys the ratchet gate consumes
+    assert {"phases", "steady_state", "anomalies", "consistency",
+            "n_events"} <= set(artifact["report"])
+
+
+def test_trace_report_gate_record():
+    ratchet = _load("ratchet")
+    tr = _load("trace_report")
+    artifact = tr.build_output("x/events.jsonl", tr.build_report(_good_events()))
+    r = ratchet.trace_report_gate_record(artifact)
+    assert r["ok"] and r["metric"] == "ratchet_trace_report_attribution"
+    assert r["wall_s"] == pytest.approx(100.0)
+    # inconsistent attribution fails the gate
+    bad = tr.build_output(
+        "x", tr.build_report(_good_events() + [
+            _span("checkpoint_save", "main:checkpoint", 51.0, 3.0),
+        ]),
+    )
+    r = ratchet.trace_report_gate_record(bad)
+    assert not r["ok"] and "inconsistent" in r["error"]
+    # a run with no flush boundaries means the recorder was dead
+    silent = tr.build_output("x", tr.build_report([
+        _span("first_step", "main:compile", 0.0, 1.0),
+        _instant("end", "events", 10.0),
+    ]))
+    r = ratchet.trace_report_gate_record(silent)
+    assert not r["ok"] and "flush-boundary" in r["error"]

@@ -226,3 +226,115 @@ def test_request_timeout_maps_to_504():
         server.shutdown()
         server.server_close()
         batcher.close(drain=False)
+
+
+def test_stats_latency_quantiles_and_metrics_exposition():
+    """Observability satellite: /stats carries p50/p95/p99 per jit bucket
+    from the clock-injectable LatencyHistogram, /metrics exposes the SAME
+    histogram in Prometheus text format plus the batcher's time-weighted
+    occupancy gauges — one measurement source, two views."""
+    from simclr_pytorch_distributed_tpu.serve.server import (
+        combined_stats_fn,
+        serve_metrics_fn,
+    )
+    from simclr_pytorch_distributed_tpu.utils.prom import LatencyHistogram
+
+    latency = LatencyHistogram()
+
+    def fake_bucket_for(n):  # the engine's smallest-bucket-≥-n contract
+        for b in (1, 8, 32):
+            if n <= b:
+                return b
+        return 32
+
+    batcher = DynamicBatcher(
+        fake_embed, max_batch=8, max_wait_ms=2,
+        latency=latency, bucket_fn=fake_bucket_for,
+    )
+
+    class FakeEngine:
+        bucket_for = staticmethod(fake_bucket_for)
+
+        def stats(self):
+            return {"requests": 2, "images": 7, "padded_rows": 3,
+                    "cache_hit_rows": 1, "bucket_dispatches": {8: 2},
+                    "cache": {"hits": 1, "misses": 6}}
+
+    server = create_server(
+        batcher, combined_stats_fn(FakeEngine(), batcher, latency),
+        port=0, metrics_fn=serve_metrics_fn(FakeEngine(), batcher, latency),
+    )
+    start_in_thread(server)
+    host, port = server.server_address[:2]
+    base = f"http://{host}:{port}"
+    try:
+        imgs = np.zeros((3, H, W, 3), np.uint8)
+        for _ in range(4):
+            batcher.submit(imgs).result(timeout=10)
+        status, stats = get(base, "/stats")
+        assert status == 200
+        lat = stats["latency"]["8"]  # n=3 pads into bucket 8
+        assert lat["count"] == 4
+        assert 0 <= lat["p50_ms"] <= lat["p95_ms"] <= lat["p99_ms"]
+        # the occupancy gauges ride the same /stats payload
+        assert "pipeline_occupancy" in stats["batcher"]
+        assert "avg_inflight_depth" in stats["batcher"]
+
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            body = r.read().decode()
+        assert 'serve_request_latency_ms_bucket{bucket="8",le="+Inf"} 4' in body
+        assert 'serve_request_latency_ms_count{bucket="8"} 4' in body
+        assert "serve_batcher_pipeline_occupancy" in body
+        assert "serve_engine_requests_total 2" in body
+        assert 'serve_engine_bucket_dispatches_total{bucket="8"} 2' in body
+        assert "serve_cache_hits 1" in body
+    finally:
+        server.shutdown()
+        server.server_close()
+        batcher.close()
+
+
+def test_metrics_404_without_metrics_fn(served):
+    """The pre-observability surface is unchanged when no metrics_fn is
+    wired (create_server default)."""
+    base, _ = served
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        get(base, "/metrics")
+    assert exc.value.code == 404
+
+
+def test_serve_watchdog_arms_only_while_inflight(tmp_path):
+    """The serve stall contract: armed on dispatch, beaten/disarmed by
+    completions — an IDLE server never pages anyone (fake clocks on both
+    sides; no real waiting)."""
+    from simclr_pytorch_distributed_tpu.utils.tracing import StallWatchdog
+
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clk = Clock()
+    wd = StallWatchdog(10.0, str(tmp_path), clock=clk, start=False,
+                       name="serve")
+    # max_wait_ms=0: a fake clock never closes a nonzero coalescing window
+    batcher = DynamicBatcher(fake_embed, max_batch=8, max_wait_ms=0,
+                             start=False, watchdog=wd, clock=clk)
+    # idle: huge silence, no fire
+    clk.t += 1000.0
+    assert not wd.check()
+    # a dispatched-and-completed batch passes through arm -> disarm
+    batcher.submit(np.zeros((2, H, W, 3), np.uint8))
+    batcher._dispatch(batcher._next_batch())
+    # the synchronous _dispatch path completes inline; manually exercise
+    # the completer's bookkeeping contract
+    wd.arm()
+    clk.t += 11.0
+    assert wd.check()  # armed + stuck fires...
+    wd.disarm()
+    clk.t += 1000.0
+    assert not wd.check()  # ...disarmed idle never does
+    batcher.close()

@@ -1,0 +1,432 @@
+"""Observability layer tests: flight recorder, stall watchdog, Prometheus
+exposition, and the tier-1 recorder-overhead proof.
+
+Everything timing-shaped runs on fake clocks (the watchdog's ``check()`` is
+the testable core — the background thread only calls it on a cadence), and
+the "zero added device transfers" claim is MECHANICAL: a real driver epoch
+runs with the recorder on while the metric ring's ``device_get`` and the
+device store's ``index_put`` count every transfer — the counts must equal
+the PR-4/PR-5 proven contract (one ring D2H per window, one index upload
+per epoch) exactly, recorder or no recorder.
+"""
+
+import json
+import logging
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu.utils import prom, tracing
+
+pytestmark = pytest.mark.obs
+
+SIZE = 8
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ------------------------------------------------------------- recorder
+
+
+def test_recorder_jsonl_roundtrip_and_snapshot(tmp_path):
+    clk = FakeClock(100.0)
+    path = str(tmp_path / "events.jsonl")
+    rec = tracing.FlightRecorder(path, clock=clk)
+    with rec.span("phase_a", track="main:flush", step=3):
+        clk.advance(0.5)
+    clk.advance(0.25)
+    rec.event("nan_rollback", track="main:guard", epoch=2)
+    rec.close()
+
+    lines = [json.loads(x) for x in open(path).read().splitlines()]
+    assert [e["name"] for e in lines] == ["phase_a", "nan_rollback"]
+    span = lines[0]
+    assert span["ph"] == "X" and span["track"] == "main:flush"
+    assert span["ts"] == pytest.approx(0.0) and span["dur"] == pytest.approx(0.5)
+    assert span["args"] == {"step": 3}
+    ev = lines[1]
+    assert ev["ph"] == "i" and ev["ts"] == pytest.approx(0.75)
+    # snapshot is the same records (the watchdog dump source)
+    snap = rec.snapshot()
+    assert [e["name"] for e in snap] == ["phase_a", "nan_rollback"]
+    assert rec.snapshot(last=1)[0]["name"] == "nan_rollback"
+
+
+def test_recorder_record_span_explicit_clock_domain():
+    clk = FakeClock(10.0)
+    rec = tracing.FlightRecorder(clock=clk)
+    start = rec.now()
+    clk.advance(2.0)
+    rec.record_span("request", "serve:request", start, rec.now(), n=4)
+    (span,) = rec.snapshot()
+    assert span["ts"] == pytest.approx(0.0) and span["dur"] == pytest.approx(2.0)
+
+
+def test_chrome_trace_export_schema(tmp_path):
+    """Schema pin: Chrome trace-event JSON with integer microsecond
+    ts/dur, thread_name metadata per track, and monotone non-overlapping
+    spans within each main:* track."""
+    clk = FakeClock()
+    trace_path = str(tmp_path / "trace.json")
+    rec = tracing.FlightRecorder(clock=clk, trace_path=trace_path)
+    for _ in range(3):  # sequential spans on one track
+        with rec.span("flush_boundary", track="main:flush"):
+            clk.advance(0.01)
+        clk.advance(0.05)
+    with rec.span("first_step", track="main:compile"):
+        clk.advance(1.0)
+    rec.event("cache_hits", track="serve:cache", rows=2)
+    rec.close()
+
+    trace = json.load(open(trace_path))
+    assert set(trace) == {"traceEvents", "displayTimeUnit"}
+    events = trace["traceEvents"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in metas} == {
+        "main:flush", "main:compile", "serve:cache"
+    }
+    by_track_tid = {m["args"]["name"]: m["tid"] for m in metas}
+    spans = [e for e in events if e["ph"] == "X"]
+    instants = [e for e in events if e["ph"] == "i"]
+    assert len(spans) == 4 and len(instants) == 1
+    for e in spans:
+        assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
+        assert e["ts"] >= 0 and e["dur"] >= 0 and e["pid"] == 0
+    # per-main-track monotone non-overlap (the attribution invariant)
+    flush = sorted(
+        (e for e in spans if e["tid"] == by_track_tid["main:flush"]),
+        key=lambda e: e["ts"],
+    )
+    assert len(flush) == 3
+    for a, b in zip(flush, flush[1:]):
+        assert b["ts"] >= a["ts"] + a["dur"]
+
+
+def test_recorder_ring_bound_drops_oldest_keeps_jsonl(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    rec = tracing.FlightRecorder(path, clock=FakeClock(), max_events=4)
+    for i in range(10):
+        rec.event(f"e{i}")
+    assert [e["name"] for e in rec.snapshot()] == ["e6", "e7", "e8", "e9"]
+    assert rec.dropped == 6
+    rec.close()
+    assert len(open(path).read().splitlines()) == 10  # disk keeps all
+
+
+def test_module_level_helpers_noop_without_install(tmp_path):
+    tracing.uninstall()
+    with tracing.span("x", track="main:flush"):
+        pass
+    tracing.event("y")
+    tracing.record_span("z", "t", 0.0, 1.0)  # all silently dropped
+    rec = tracing.FlightRecorder(clock=FakeClock())
+    tracing.install(rec)
+    try:
+        with tracing.span("x", track="main:flush"):
+            pass
+        tracing.event("y")
+    finally:
+        tracing.uninstall()
+    assert [e["name"] for e in rec.snapshot()] == ["x", "y"]
+
+
+# ------------------------------------------------------------- watchdog
+
+
+def test_watchdog_fires_on_stuck_boundary_and_dumps_artifacts(tmp_path):
+    clk = FakeClock()
+    rec = tracing.FlightRecorder(clock=clk)
+    rec.event("last_good_boundary", track="main:flush", step=40)
+    wd = tracing.StallWatchdog(
+        10.0, str(tmp_path), clock=clk, recorder=rec, start=False,
+        name="train",
+    )
+    wd.beat()
+    clk.advance(5.0)
+    assert not wd.check()  # within deadline: silent
+    clk.advance(6.0)
+    assert wd.check()  # 11s > 10s: fires
+    txt = tmp_path / "stall_dump_1.txt"
+    js = tmp_path / "stall_dump_1.json"
+    assert txt.exists() and js.exists()
+    body = txt.read_text()
+    # faulthandler wrote real stacks: this very test frame is in them
+    assert "STALL" in body and "test_tracing" in body
+    dump = json.loads(js.read_text())
+    assert dump["age_s"] == pytest.approx(11.0)
+    assert any(e["name"] == "last_good_boundary" for e in dump["events"])
+    # one dump per stall: no re-fire until a beat re-arms
+    clk.advance(100.0)
+    assert not wd.check()
+    wd.beat()
+    clk.advance(11.0)
+    assert wd.check()
+    assert (tmp_path / "stall_dump_2.txt").exists()
+
+
+def test_watchdog_silent_on_healthy_run(tmp_path):
+    clk = FakeClock()
+    wd = tracing.StallWatchdog(10.0, str(tmp_path), clock=clk, start=False)
+    for _ in range(20):
+        clk.advance(5.0)
+        wd.beat()
+        assert not wd.check()
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_watchdog_disarm_suppresses_then_arm_restores(tmp_path):
+    clk = FakeClock()
+    wd = tracing.StallWatchdog(10.0, str(tmp_path), clock=clk, start=False)
+    wd.disarm()
+    clk.advance(100.0)
+    assert not wd.check()  # disarmed silence is expected (idle serve)
+    wd.arm()
+    assert not wd.check()  # arm() beats: full deadline from here
+    clk.advance(11.0)
+    assert wd.check()
+
+
+def test_watchdog_rejects_nonpositive_deadline(tmp_path):
+    with pytest.raises(ValueError):
+        tracing.StallWatchdog(0.0, str(tmp_path), start=False)
+
+
+# ------------------------------------------------- logging dedup satellite
+
+
+def test_setup_logging_dedups_file_handlers(tmp_path):
+    """Regression (satellite): repeated setup_logging calls against the
+    same work_dir must not stack duplicate ``log-ing`` FileHandlers — each
+    stacked handler wrote every line once more (resume loops, tests)."""
+    from simclr_pytorch_distributed_tpu.utils.logging_utils import setup_logging
+
+    root = logging.getLogger()
+    before = list(root.handlers)
+    try:
+        for _ in range(3):
+            setup_logging(str(tmp_path), is_main=True)
+        target = os.path.abspath(os.path.join(str(tmp_path), "log-ing"))
+        mine = [
+            h for h in root.handlers
+            if isinstance(h, logging.FileHandler)
+            and getattr(h, "baseFilename", None) == target
+        ]
+        assert len(mine) == 1
+        logging.getLogger().info("exactly-once-line")
+        mine[0].flush()
+        text = open(target).read()
+        assert text.count("exactly-once-line") == 1
+    finally:
+        for h in list(root.handlers):
+            if h not in before:
+                root.removeHandler(h)
+                h.close()
+
+
+# ------------------------------------------------------------------ prom
+
+
+def test_render_prometheus_format_and_escaping():
+    text = prom.render_prometheus([
+        ("train_step", None, 42),
+        ("lat_bucket", {"bucket": "8", "le": "+Inf"}, 3),
+        ("weird", {"l": 'a"b\nc'}, 1.5),
+    ])
+    lines = text.splitlines()
+    assert lines[0] == "train_step 42"
+    assert lines[1] == 'lat_bucket{bucket="8",le="+Inf"} 3'
+    assert "\\n" in lines[2] and '\\"' in lines[2]
+    assert text.endswith("\n")
+
+
+def test_latency_histogram_quantiles_and_samples():
+    h = prom.LatencyHistogram(bounds_ms=(1, 10, 100, 1000))
+    for ms in (5, 5, 5, 5, 5, 5, 5, 5, 5, 50):  # 9 fast + 1 slow
+        h.observe(8, ms)
+    s = h.summary()["8"]
+    assert s["count"] == 10
+    assert 1 < s["p50_ms"] <= 10
+    assert 10 < s["p95_ms"] <= 100  # the slow one pulls the tail bucket
+    assert s["p50_ms"] <= s["p95_ms"] <= s["p99_ms"]
+    # overflow clamps to the top bound instead of inventing a number
+    h.observe("big", 99999)
+    assert h.quantile("big", 0.5) == 1000
+    samples = h.samples("req_ms")
+    names = {n for n, _, _ in samples}
+    assert names == {"req_ms_bucket", "req_ms_sum", "req_ms_count"}
+    inf_8 = [v for n, lab, v in samples
+             if n == "req_ms_bucket" and lab == {"bucket": "8", "le": "+Inf"}]
+    assert inf_8 == [10]
+    # cumulative within one key: counts never decrease along the bounds
+    buckets_8 = [v for n, lab, v in samples
+                 if n == "req_ms_bucket" and lab.get("bucket") == "8"]
+    assert buckets_8 == sorted(buckets_8)
+
+
+def test_latency_histogram_rejects_bad_bounds():
+    with pytest.raises(ValueError):
+        prom.LatencyHistogram(bounds_ms=(10, 5))
+
+
+def test_trainer_gauges_liveness_age():
+    clk = FakeClock()
+    g = prom.TrainerGauges(clock=clk)
+    assert g.collect()["last_boundary_age_seconds"] == -1.0  # no beat yet
+    g.beat(120)
+    g.set(epoch=3, inflight_windows=1)
+    clk.advance(7.5)
+    g.register("checkpoint_pending_saves", lambda: 2)
+    out = g.collect()
+    assert out["step"] == 120 and out["epoch"] == 3
+    assert out["last_boundary_age_seconds"] == pytest.approx(7.5)
+    assert out["checkpoint_pending_saves"] == 2
+    g.register("broken", lambda: 1 / 0)
+    assert g.collect()["broken"] == -1.0  # a scrape never raises
+    assert "train_step 120" in g.prometheus_text()
+
+
+def test_metrics_sidecar_http_endpoint():
+    g = prom.TrainerGauges(clock=FakeClock())
+    g.beat(7)
+    server = prom.start_metrics_server(0, g.prometheus_text, host="127.0.0.1")
+    try:
+        host, port = server.server_address[:2]
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10
+        ) as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            body = r.read().decode()
+        assert "train_step 7" in body
+        assert "train_last_boundary_age_seconds" in body
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/healthz", timeout=10
+        ) as r:
+            assert json.loads(r.read()) == {"status": "ok"}
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ------------------------------------ the recorder-overhead proof (tier-1)
+
+
+def test_recorder_adds_no_device_transfers_in_driver_hot_loop(
+    tmp_path, monkeypatch
+):
+    """The acceptance-criteria proof, mechanical: one REAL supcon epoch
+    under device placement with the flight recorder ON, every ring D2H
+    counted through the MetricRing's injectable ``device_get`` and every
+    index upload through the DeviceStore's ``index_put``. The counts must
+    equal the PR-4/PR-5 contract exactly — 3 ring transfers (windows
+    2+2+1 of a 5-step epoch at print_freq 2) and 1 index upload (one
+    epoch) — so the recorder added ZERO device transfers between flush
+    boundaries, while events.jsonl proves it was live the whole time."""
+    import jax as _jax
+
+    from simclr_pytorch_distributed_tpu import config as config_lib
+    from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib
+    from simclr_pytorch_distributed_tpu.data import device_store
+    from simclr_pytorch_distributed_tpu.parallel import mesh as mesh_lib
+    from simclr_pytorch_distributed_tpu.train import supcon as supcon_driver
+    from simclr_pytorch_distributed_tpu.utils.telemetry import TelemetrySession
+
+    orig_synth = cifar_lib.synthetic_dataset
+    monkeypatch.setattr(
+        cifar_lib, "synthetic_dataset",
+        lambda n=2048, num_classes=10, seed=0, size=32: orig_synth(
+            n=200, num_classes=num_classes, seed=seed, size=SIZE
+        ),
+    )
+    monkeypatch.setattr(
+        supcon_driver, "create_mesh",
+        lambda devices=None, **kw: mesh_lib.create_mesh(
+            devices=_jax.devices()[:1] if devices is None else devices, **kw
+        ),
+    )
+
+    counts = {"ring": 0, "index": 0}
+
+    class CountingSession(TelemetrySession):
+        def __init__(self, window, keys, mode="async", **kw):
+            def counting_get(x):
+                counts["ring"] += 1
+                return _jax.device_get(x)
+
+            super().__init__(
+                window, keys, mode, device_get=counting_get, **kw
+            )
+
+    real_store = device_store.DeviceStore
+
+    class CountingStore(real_store):
+        def __init__(self, loader, mesh, **kw):
+            super().__init__(loader, mesh, **kw)
+            inner = self._index_put
+
+            def counting_put(idx):
+                counts["index"] += 1
+                return inner(idx)
+
+            self._index_put = counting_put
+
+    monkeypatch.setattr(supcon_driver, "TelemetrySession", CountingSession)
+    monkeypatch.setattr(device_store, "DeviceStore", CountingStore)
+
+    cfg = config_lib.SupConConfig(
+        model="resnet10", dataset="synthetic", batch_size=32, epochs=1,
+        learning_rate=0.05, cosine=True, save_freq=5, print_freq=2,
+        size=SIZE, workdir=str(tmp_path), seed=0, method="SimCLR",
+        telemetry="sync", data_placement="device", flight_recorder="on",
+    )
+    cfg = config_lib.finalize_supcon(cfg)
+    supcon_driver.run(cfg)
+
+    # the mechanical bound: exactly the pre-recorder transfer contract
+    assert counts == {"ring": 3, "index": 1}
+
+    # ...and the recorder really was on through the whole loop
+    events_path = os.path.join(cfg.save_folder, "events.jsonl")
+    events = [json.loads(x) for x in open(events_path).read().splitlines()]
+    boundaries = [e for e in events if e["name"] == "flush_boundary"]
+    # 3 real windows (2+2+1) + the epoch-tail boundary finish_epoch submits
+    # with ZERO pending steps — a span records (the recorder saw it) but no
+    # transfer happened (the ring count above stayed 3)
+    assert len([b for b in boundaries if b["args"]["steps"] > 0]) == 3
+    assert all(b["args"]["steps"] == 0 for b in boundaries[3:])
+    assert any(e["name"] == "first_step" for e in events)
+    assert any(e["name"] == "epoch_gather" for e in events)
+    assert any(e["name"] == "epoch" for e in events)
+    assert any(e["name"] == "checkpoint_save" for e in events)
+    assert os.path.exists(os.path.join(cfg.save_folder, "trace.json"))
+
+
+def test_run_paths_rotate_per_session(tmp_path):
+    """A resumed run (exit-75 relaunch into the SAME save_folder) must not
+    append a second ts~0 timeline into the first session's events.jsonl —
+    each session gets a fresh _rK file, one self-consistent timeline per
+    file (trace_report consumes them independently)."""
+    e1, t1 = tracing.run_paths(str(tmp_path))
+    assert os.path.basename(e1) == "events.jsonl"
+    open(e1, "w").write("{}\n")
+    e2, t2 = tracing.run_paths(str(tmp_path))
+    assert os.path.basename(e2) == "events_r2.jsonl"
+    assert os.path.basename(t2) == "trace_r2.json"
+    open(e2, "w").write("{}\n")
+    e3, _ = tracing.run_paths(str(tmp_path))
+    assert os.path.basename(e3) == "events_r3.jsonl"
+    # pod processes rotate independently under their own _pN prefix
+    ep, tp = tracing.run_paths(str(tmp_path), process_index=1)
+    assert os.path.basename(ep) == "events_p1.jsonl"
+    assert os.path.basename(tp) == "trace_p1.json"
