@@ -127,7 +127,29 @@ CONFIGS = {
     # list.
     "trace_report": dict(model="resnet10", epochs=1, bar=None,
                          kind="trace_report", dataset="synthetic"),
+    # round 10: the training-health smoke (docs/OBSERVABILITY.md "Training
+    # health") — one tiny pretrain epoch with the on-device diagnostics +
+    # online probe on, then scripts/health_report.py over its events.jsonl.
+    # The gate binds everywhere on the stream's internal consistency (every
+    # window carries the full health column set, steps monotone — i.e. the
+    # in-step diagnostics really reached the recorder through the ring) and
+    # on ZERO detector alarms (the healthy smoke must not trip the collapse
+    # detector — a false positive here would abort real runs under
+    # --health_policy abort). The online-probe accuracy claim is calibrated
+    # on CPU (HEALTH_PROBE_CPU_BAR) and pass-skips elsewhere, the
+    # bench-gate convention. Seconds-to-minutes, so it rides the default
+    # list.
+    "health_report": dict(model="resnet10", epochs=1, bar=None,
+                          kind="health_report", dataset="synthetic"),
 }
+
+# CPU-calibrated bar for the health_report smoke's online probe: best
+# window top-1 after one epoch of the gate's `synthetic` color-mean config
+# (chance 10%; calibration runs measured best-window 35.5 at 1 epoch and
+# 48.6 at 2 — the round-10 evidence runs). Generous margin — the claim is
+# "the probe LEARNS, live, from inside the compiled update", not a precise
+# accuracy.
+HEALTH_PROBE_CPU_BAR = 20.0
 
 
 def bench_metric_name(spec):
@@ -282,6 +304,70 @@ def trace_report_gate_record(artifact):
     return record
 
 
+def health_report_gate_record(artifact, probe_bar=None):
+    """Gate decision for one health_report artifact (pure — tested without
+    a driver run).
+
+    Binds on EVERY device (the trace_report convention): the health stream's
+    internal consistency — non-empty, monotone, full column set per window —
+    is a property of the ring->recorder contract, not of any timing or
+    accuracy number; and zero ``health_alarm`` events, because the collapse
+    detector firing on a known-healthy smoke is exactly the false positive
+    that would abort real runs under ``--health_policy abort``. The
+    online-probe learning claim (best window top-1 over ``probe_bar``) is
+    calibrated on the CPU smoke; on any other device it pass-skips with the
+    reason on record (the bench gate's device-kind convention) while the
+    consistency and zero-alarm bits still bind.
+    """
+    if probe_bar is None:
+        probe_bar = HEALTH_PROBE_CPU_BAR
+    rep = artifact["report"]
+    cons = rep["consistency"]
+    probe = rep.get("probe") or {}
+    record = {
+        "metric": "ratchet_health_report",
+        "value": probe.get("best_top1"),
+        "bar": probe_bar,
+        "n_windows": cons["n_windows"],
+        "alarms": len(rep["alarms"]),
+        "findings": [f["flag"] for f in rep["findings"]],
+        "device": artifact.get("device"),
+    }
+    if not cons["ok"]:
+        record["ok"] = False
+        record["error"] = (
+            "health stream inconsistent: empty/non-monotone timeline or "
+            f"missing columns {cons['missing_keys']}"
+        )
+        return record
+    if rep["alarms"]:
+        record["ok"] = False
+        record["error"] = (
+            f"collapse detector fired {len(rep['alarms'])}x on the healthy "
+            "smoke (false positive)"
+        )
+        return record
+    if not probe:
+        record["ok"] = False
+        record["error"] = "no online-probe columns in the health stream"
+        return record
+    if artifact.get("device") != "cpu":
+        record["ok"] = True
+        record["skipped"] = (
+            f"device {artifact.get('device')!r}: probe-accuracy bar "
+            "calibrated for the CPU smoke only; stream consistency and "
+            "zero-alarm checks still enforced"
+        )
+        return record
+    record["ok"] = bool(probe["best_top1"] >= probe_bar)
+    if not record["ok"]:
+        record["error"] = (
+            f"online probe best top-1 {probe['best_top1']:.2f} < "
+            f"{probe_bar:g}: the live probe did not learn"
+        )
+    return record
+
+
 class ConfigFailed(RuntimeError):
     """One gated config could not produce a number; the others must still run."""
 
@@ -408,6 +494,56 @@ def run_config(name, spec, epochs, bar, args):
         print(json.dumps(record), flush=True)
         return record
 
+    if kind == "health_report":
+        # the training-health smoke: one tiny pretrain epoch with the
+        # on-device diagnostics + online probe, then the health timeline
+        # report over its events.jsonl (health_report_gate_record)
+        pre_log = os.path.join(logs, "pretrain.log")
+        run(
+            [sys.executable, "main_supcon.py", "--dataset", dataset,
+             "--model", model, "--epochs", str(max(1, epochs)),
+             "--batch_size", "64", "--learning_rate", "0.05",
+             "--print_freq", "4", "--save_freq", "1",
+             "--health_freq", "2", "--online_probe", "on",
+             "--health_policy", "warn", "--workdir", args.workdir,
+             "--seed", str(args.seed), "--trial", trial],
+            pre_log,
+        )
+        models = os.path.join(args.workdir, f"{dataset}_models")
+        runs = [
+            os.path.join(models, d) for d in os.listdir(models)
+            if d.endswith(f"trial_{trial}")
+        ]
+        if not runs:
+            raise ConfigFailed(f"no run dir matching trial_{trial} in {models}")
+        run_dir = max(runs, key=os.path.getmtime)
+        events = os.path.join(run_dir, "events.jsonl")
+        report_json = os.path.join(logs, "health_report.json")
+        report_log = os.path.join(logs, "health_report.log")
+        try:
+            run(
+                [sys.executable, "scripts/health_report.py", "--events",
+                 events, "--json", report_json],
+                report_log,
+            )
+        except ConfigFailed:
+            # health_report exits nonzero on an INCONSISTENT stream but
+            # still writes the artifact — fall through so the gate record
+            # fails with the structured verdict (missing_keys/n_windows)
+            # instead of a generic subprocess error; re-raise only when
+            # there is no artifact to judge
+            if not os.path.exists(report_json):
+                raise
+        try:
+            with open(report_json) as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ConfigFailed(f"health_report wrote no artifact: {e}") from e
+        record = health_report_gate_record(artifact, probe_bar=bar)
+        record["log"] = report_log
+        print(json.dumps(record), flush=True)
+        return record
+
     if kind == "ce":
         # the CE trainer end-to-end: train + validate in one driver
         # (protocol of docs/evidence/ce_30ep.log: rn50, lr 0.1 cosine, bf16)
@@ -507,6 +643,8 @@ def main():
                 metric = bench_metric_name(spec)
             elif spec["kind"] == "trace_report":
                 metric = "ratchet_trace_report_attribution"
+            elif spec["kind"] == "health_report":
+                metric = "ratchet_health_report"
             elif spec["kind"] in ("resident_ab", "window_ab"):
                 metric = f"ratchet_{spec['kind']}_equivalence"
             else:

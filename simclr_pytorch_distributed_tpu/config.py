@@ -136,6 +136,23 @@ class SupConConfig:
     # — untunable exactly where it matters without this)
     device_budget_mb: int = 0
     # --- observability (docs/OBSERVABILITY.md) ---
+    # representation-health diagnostics (train/supcon_step.py
+    # HEALTH_METRIC_KEYS): alignment / uniformity / contrastive top-1 /
+    # negative-similarity stats / gradient norm / embedding effective rank,
+    # computed inside the jitted update every health_freq-th step and shipped
+    # through the existing metric ring (zero new per-step D2H); 0 = off
+    health_freq: int = 10
+    # what a collapse/divergence verdict does (utils/guard.HealthMonitor):
+    # 'warn' logs + emits health_alarm flight-recorder events; 'abort' exits
+    # with RepresentationHealthError (collective, like the NaN exit; NEVER
+    # rolled back — see docs/RESILIENCE.md precedence note)
+    health_policy: str = "warn"
+    # online linear probe (train/supcon_step.py): a detached classifier head
+    # on stop_gradient encoder features trained by the same compiled update,
+    # so probe top-1 streams live through the ring instead of waiting for
+    # the post-hoc main_linear.py pass; checkpointed in its own payload
+    online_probe: str = "off"
+    probe_lr: float = 0.1
     # flight recorder (utils/tracing.py): host-boundary span/event log ->
     # <run_dir>/events.jsonl + Chrome-trace trace.json; zero device
     # syncs/transfers added (asserted mechanically in tier-1)
@@ -339,8 +356,48 @@ def supcon_parser() -> argparse.ArgumentParser:
                    help="override the per-device placement budget in MB "
                         "(default: 0.4x free memory_stats, 4 GB fallback "
                         "where the backend reports no stats)")
+    p.add_argument("--health_freq", type=nonnegative_int_arg("health_freq"),
+                   default=d.health_freq,
+                   help="compute the representation-health diagnostics "
+                        "(alignment/uniformity/contrastive top-1/negative "
+                        "sims/grad norm/effective rank) inside the jitted "
+                        "update every Nth step, shipped through the metric "
+                        "ring (no new per-step transfers); 0 = off")
+    p.add_argument("--health_policy", type=str, default=d.health_policy,
+                   choices=["warn", "abort"],
+                   help="on a windowed collapse/divergence verdict: log + "
+                        "flight-recorder event, or exit with the typed "
+                        "RepresentationHealthError (never rolled back)")
+    p.add_argument("--online_probe", type=str, default=d.online_probe,
+                   choices=["on", "off"],
+                   help="train a detached linear probe on stop_gradient "
+                        "encoder features inside the same compiled update; "
+                        "probe loss/top-1 stream live through the ring")
+    p.add_argument("--probe_lr", type=float, default=d.probe_lr,
+                   help="online probe SGD learning rate (constant; the "
+                        "probe chases a moving encoder)")
     _add_observability_flags(p, d)
     return p
+
+
+def nonnegative_int_arg(name: str):
+    """argparse type for cadence flags where 0 means 'off' but negatives are
+    nonsense (the positive_int_arg convention, with 0 admitted)."""
+
+    def parse(s: str) -> int:
+        try:
+            v = int(s)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"--{name} expects a non-negative integer, got {s!r}"
+            ) from None
+        if v < 0:
+            raise argparse.ArgumentTypeError(
+                f"--{name} must be >= 0 (0 = off), got {v}"
+            )
+        return v
+
+    return parse
 
 
 def _add_observability_flags(p: argparse.ArgumentParser, d) -> None:

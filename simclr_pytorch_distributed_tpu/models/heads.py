@@ -102,6 +102,16 @@ class SupConResNet(nn.Module):
         (reference main_linear.py:170-172)."""
         return self.encoder(x, train=train)
 
+    def forward_with_features(self, x: jax.Array, *, train: bool = True):
+        """``(projection, encoder_features)`` from ONE backbone forward.
+
+        The online linear probe (train/supcon_step.py) trains on
+        ``stop_gradient`` of the encoder features the contrastive forward
+        already computes — this method exposes them without a second
+        backbone pass (``__call__`` discards the intermediate)."""
+        h = self.encoder(x, train=train)
+        return self.proj_head(h), h
+
 
 def infer_architecture_from_variables(variables: dict) -> Tuple[str, str, int]:
     """``(model_name, head, feat_dim)`` from a ``SupConResNet`` params tree.

@@ -31,6 +31,20 @@ def topk_accuracy(
     return tuple(res)
 
 
+def topk_correct(logits: jax.Array, labels: jax.Array, ks=(1, 5)):
+    """Per-batch top-k correct counts (sum-able across shards/batches).
+
+    Shared by the probe/CE ring steps (train/linear.py, train/ce.py) and the
+    pretrain step's online probe (train/supcon_step.py) — lives here rather
+    than in train/linear.py so supcon_step can use it without an import
+    cycle through the driver modules.
+    """
+    maxk = max(ks)
+    _, pred = jax.lax.top_k(logits, maxk)
+    hit = pred == labels[:, None]
+    return {k: jnp.sum(jnp.any(hit[:, :k], axis=1)) for k in ks}
+
+
 class AverageMeter:
     """Running value/average meter (reference ``util.py:19-34``)."""
 
@@ -50,56 +64,15 @@ class AverageMeter:
         self.avg = self.sum / self.count
 
 
-class MetricBuffer:
-    """Buffers per-step device metric dicts; fetches them in ONE batched
-    device->host transfer on ``flush()``.
-
-    The reference reads ``loss.item()`` every iteration (main_supcon.py:320) —
-    a sync point that stalls dispatch. Fetching only every ``print_freq`` steps
-    (round-1 behavior) kept dispatch async but subsampled the meters/TB curves
-    to ~1/print_freq of the steps. Buffering gives both: every step is metered
-    and TB-logged at reference cadence, with one transfer per flush instead of
-    one per step.
-
-    As of the zero-sync telemetry round the trainers all write the
-    :class:`MetricRing` instead; this class has NO production callers and is
-    retained only as the compile-free pre-ring reference implementation (and
-    the fallback for a future caller whose step can't thread a ring buffer).
-    """
-
-    def __init__(self) -> None:
-        self._steps = []  # (step_info, {name: device scalar})
-
-    def append(self, info, metrics: dict) -> None:
-        self._steps.append((info, metrics))
-
-    def flush(self):
-        """Returns [(info, {name: float})] for all buffered steps; clears."""
-        if not self._steps:
-            return []
-        keys = sorted(self._steps[0][1])
-        # jax.device_get on the plain nested list batches all the D2H copies
-        # into one async sweep WITHOUT building an XLA program — a jnp.stack
-        # here would compile a new program for every distinct (n_steps, n_keys)
-        # buffer shape (tail windows differ), which dominated driver runtime on
-        # the CPU test host.
-        fetched = jax.device_get([[m[k] for k in keys] for _, m in self._steps])
-        out = [
-            (info, dict(zip(keys, (float(v) for v in row))))
-            for (info, _), row in zip(self._steps, fetched)
-        ]
-        self._steps = []
-        return out
-
-
 class MetricRing:
     """Device-side ``[window, K]`` fp32 metric ring + its host bookkeeping.
 
-    :class:`MetricBuffer` already batches the per-window readback into one
-    ``device_get`` *call*, but each buffered step still holds ~K live device
-    scalars, so the runtime issues one tiny D2H descriptor per scalar —
-    ~window*K transfers per flush (~110 ms/window on a tunneled link,
-    docs/PERF.md round 5). The ring closes that: the jitted step writes its
+    The pre-ring ``MetricBuffer`` (deleted once the last trainer moved to the
+    ring) batched the per-window readback into one ``device_get`` *call*, but
+    each buffered step still held ~K live device scalars, so the runtime
+    issued one tiny D2H descriptor per scalar — ~window*K transfers per flush
+    (~110 ms/window on a tunneled link, docs/PERF.md round 5). The ring
+    closes that: the jitted step writes its
     metrics into row ``step % window`` of ONE device array
     (:meth:`write`, a ``dynamic_update_slice`` inside the compiled program,
     carried with the train state under the same donation discipline), and a

@@ -34,7 +34,7 @@ from simclr_pytorch_distributed_tpu.ops.augment import (
     eval_batch,
 )
 from simclr_pytorch_distributed_tpu.ops.losses import cross_entropy_loss
-from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
+from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter, topk_correct
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
     batch_sharding,
@@ -51,7 +51,6 @@ from simclr_pytorch_distributed_tpu.train.linear import (
     jit_scalar_or_ring_step,
     run_validation,
     stats_for,
-    topk_correct,
 )
 from simclr_pytorch_distributed_tpu.train.supcon import enable_compile_cache
 from simclr_pytorch_distributed_tpu.utils import preempt

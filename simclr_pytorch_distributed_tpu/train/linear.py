@@ -51,7 +51,7 @@ from simclr_pytorch_distributed_tpu.ops.augment import (
     eval_batch,
 )
 from simclr_pytorch_distributed_tpu.ops.losses import cross_entropy_loss
-from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
+from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter, topk_correct
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
     batch_sharding,
@@ -122,14 +122,6 @@ def build_probe(cfg: config_lib.LinearConfig, steps_per_epoch: int, encoder_vari
         return jax.lax.stop_gradient(feats.astype(jnp.float32))
 
     return encoder, classifier, schedule, tx, state, encode
-
-
-def topk_correct(logits: jax.Array, labels: jax.Array, ks=(1, 5)):
-    """Per-batch top-k correct counts (sum-able across shards/batches)."""
-    maxk = max(ks)
-    _, pred = jax.lax.top_k(logits, maxk)
-    hit = pred == labels[:, None]
-    return {k: jnp.sum(jnp.any(hit[:, :k], axis=1)) for k in ks}
 
 
 def jit_scalar_or_ring_step(

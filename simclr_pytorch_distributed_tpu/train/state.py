@@ -29,6 +29,17 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
     # SEC feature-norm EMA (reference opt.record_norm_mean, main_supcon.py:304-307).
     record_norm_mean: jax.Array
+    # Online linear probe (--online_probe, train/supcon_step.py): a detached
+    # classifier head trained by the same compiled update on stop_gradient
+    # encoder features, so probe top-1 streams through the metric ring live
+    # instead of waiting for the post-hoc main_linear.py pass. ``None`` (an
+    # empty pytree node) when the probe is off — the state tree, checkpoint
+    # layout, and jit cache keys are then exactly the pre-probe ones. When
+    # present the pair is checkpointed as its own ``probe`` payload
+    # (utils/checkpoint.py), so resume restores the probe mid-trajectory and
+    # probe-off consumers (warm start, serving) never see it.
+    probe_params: Any = None
+    probe_opt_state: Any = None
 
 
 def make_optimizer(

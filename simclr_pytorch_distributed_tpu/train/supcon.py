@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import math
 import os
 import time
 
@@ -35,7 +36,7 @@ from simclr_pytorch_distributed_tpu.data.cifar import (
 from simclr_pytorch_distributed_tpu.data import device_store
 from simclr_pytorch_distributed_tpu.data.device_store import slice_epoch_step
 from simclr_pytorch_distributed_tpu.data.pipeline import EpochLoader
-from simclr_pytorch_distributed_tpu.models import SupConResNet
+from simclr_pytorch_distributed_tpu.models import MODEL_DICT, SupConResNet
 from simclr_pytorch_distributed_tpu.ops.augment import (
     DATASET_STATS,
     AugmentConfig,
@@ -63,10 +64,14 @@ from simclr_pytorch_distributed_tpu.train.state import (
     realign_schedule_count,
 )
 from simclr_pytorch_distributed_tpu.train.supcon_step import (
+    HEALTH_METRIC_KEYS,
     METRIC_KEYS,
+    ONLINE_PROBE_METRIC_KEYS,
     SupConStepConfig,
+    build_online_probe,
     epoch_position,
     make_train_step,
+    metric_keys,
 )
 from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     jit_copy_tree,
@@ -173,13 +178,30 @@ def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1
         loss_impl=resolve_loss_impl(
             cfg.loss_impl, cfg.batch_size, n_devices, cfg.model_parallel
         ),
+        health=cfg.health_freq > 0,
+        health_freq=max(1, cfg.health_freq),
+        online_probe=cfg.online_probe == "on",
     )
     return model, schedule, tx, state, step_cfg
 
 
+def attach_online_probe(cfg: config_lib.SupConConfig, state, n_cls: int):
+    """``(state_with_probe_slots, OnlineProbe)`` for a ``--online_probe on``
+    run: the classifier head + its optimizer (train/supcon_step.py), with
+    the trainable probe state attached to the TrainState so it rides the
+    jitted update, the donation discipline, and the checkpoint ``probe``
+    payload. ``n_cls`` comes from the dataset's own labels, so 'path' trees
+    need no extra flag."""
+    spec, params, opt_state = build_online_probe(
+        cfg.model, MODEL_DICT[cfg.model][1], n_cls, cfg.probe_lr,
+        seed=cfg.seed,
+    )
+    return state.replace(probe_params=params, probe_opt_state=opt_state), spec
+
+
 def make_fused_update(
     model, tx, schedule, step_cfg, aug_cfg, mesh, state_example,
-    metric_ring=None, resident=False, window_batches=None,
+    metric_ring=None, resident=False, window_batches=None, probe=None,
 ):
     """augment(two crops) + train step as one GSPMD program.
 
@@ -208,8 +230,14 @@ def make_fused_update(
     streaming ``[window_batches, batch, ...]`` window (a WindowStore): the
     in-program position becomes ``epoch_position % window_batches``, valid
     because windows are aligned to multiples of the window length.
+
+    ``probe`` (an OnlineProbe, required iff ``step_cfg.online_probe``) adds
+    the detached online-probe update to the same compiled program
+    (train/supcon_step.py) — its metrics ride the ring like everything else.
     """
-    train_step = make_train_step(model, tx, schedule, step_cfg, mesh=mesh)
+    train_step = make_train_step(
+        model, tx, schedule, step_cfg, mesh=mesh, probe=probe
+    )
     repl = replicated_sharding(mesh)
     state_sh = state_sharding(mesh, state_example)
     if resident:
@@ -255,10 +283,20 @@ TB_ITER_SCALARS = (  # reference per-iter scalars, main_supcon.py:327-333
     "norm_mean", "norm_var", "record_norm_mean", "loss_sec", "loss_l2reg",
 )
 
+# training-health TB tags (docs/OBSERVABILITY.md "Training health"): the
+# ring's health/probe columns, logged at the TRUE global step like info/*
+# so a collapse correlates directly against the loss curves. NaN sentinel
+# rows (non-health steps) are skipped host-side.
+EXTRA_TB_TAGS = {
+    **{k: "health/" + k[len("health_"):] for k in HEALTH_METRIC_KEYS},
+    **{k: "probe/" + k[len("probe_"):] for k in ONLINE_PROBE_METRIC_KEYS},
+}
+
 
 def train_one_epoch(
     epoch, loader, update_fn, state, mesh, base_key, cfg, tb, steps_per_epoch,
     tracer=None, start_step=0, telemetry=None, store=None, compile_span=False,
+    health_monitor=None, gauges=None,
 ):
     """One epoch (reference train(), main_supcon.py:242-351).
 
@@ -308,7 +346,12 @@ def train_one_epoch(
     """
     owns_telemetry = telemetry is None
     if owns_telemetry:
-        telemetry = TelemetrySession(cfg.print_freq, METRIC_KEYS, cfg.telemetry)
+        telemetry = TelemetrySession(
+            cfg.print_freq,
+            metric_keys(health=cfg.health_freq > 0,
+                        online_probe=cfg.online_probe == "on"),
+            cfg.telemetry,
+        )
     batch_time, data_time, losses = AverageMeter(), AverageMeter(), AverageMeter()
     end = time.time()
     last_host = {}  # most recently flushed metrics, as python floats
@@ -341,8 +384,22 @@ def train_one_epoch(
                     it = (epoch - 1) * steps_per_epoch + idx_f
                     for name in TB_ITER_SCALARS:
                         tb.log_value(f"info/{name}", m[name], it)
+                    for name, tag in EXTRA_TB_TAGS.items():
+                        # NaN = the lax.cond sentinel for a non-health step
+                        if name in m and math.isfinite(m[name]):
+                            tb.log_value(tag, m[name], it)
                 last_host.clear()
                 last_host.update(m)
+            if health_monitor is not None:
+                # windowed collapse/divergence evaluation (utils/guard.py):
+                # emits health_window/health_alarm recorder events, stamps
+                # the sidecar gauges, and under --health_policy abort raises
+                # here on the telemetry thread — surfaced COLLECTIVELY at
+                # the next boundary as failure code 3, like the NaN check
+                health_monitor.ingest(
+                    [(gstep_f, m) for (_, gstep_f), m in fetched],
+                    gauges=gauges,
+                )
             logging.info(
                 "Train: [%d][%d/%d]\tBT %.3f (%.3f)\tDT %.3f (%.3f)\t"
                 "loss %.3f (%.3f)\tnorm_mean %.3f (record: %.3f) var %.3f",
@@ -492,6 +549,19 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
     )
     model, schedule, tx, state, step_cfg = build(cfg, steps_per_epoch, mesh.size)
     logging.info("contrastive loss impl: %s", step_cfg.loss_impl)
+    probe = None
+    if cfg.online_probe == "on":
+        # attach BEFORE any resume restore: the abstract state then carries
+        # the probe slots, so restore_checkpoint brings the probe payload
+        # back (or degrades to the fresh init with a warning)
+        state, probe = attach_online_probe(
+            cfg, state, int(train_data["labels"].max()) + 1
+        )
+        logging.info(
+            "online probe: %d-class linear head on stop_gradient encoder "
+            "features (lr %g)", int(train_data["labels"].max()) + 1,
+            cfg.probe_lr,
+        )
 
     start_epoch, start_step = 1, 0
     if cfg.ckpt:
@@ -529,7 +599,9 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
     # loop hands each print_freq window to (utils/telemetry.py). The
     # watchdog/gauges ride its flush boundaries.
     telemetry = TelemetrySession(
-        cfg.print_freq, METRIC_KEYS, cfg.telemetry,
+        cfg.print_freq,
+        metric_keys(health=step_cfg.health, online_probe=step_cfg.online_probe),
+        cfg.telemetry,
         watchdog=obs.watchdog, gauges=obs.gauges,
     )
 
@@ -540,6 +612,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         store_kwargs = dict(
             resident=store is not None,
             window_batches=None if store is None else store.window_batches,
+            probe=probe,
         )
         if lr_scale == 1.0:
             return make_fused_update(
@@ -615,6 +688,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
                         tb, steps_per_epoch, tracer=tracer, start_step=ss,
                         telemetry=telemetry, store=store,
                         compile_span=(epoch == start_epoch),
+                        health_monitor=obs.health, gauges=obs.gauges,
                     )
             except NonFiniteLossError:
                 # emergency save of the epoch-top state so --resume can

@@ -38,7 +38,12 @@ import optax
 from simclr_pytorch_distributed_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
-from simclr_pytorch_distributed_tpu.ops.losses import supcon_loss
+from simclr_pytorch_distributed_tpu.models import LinearClassifier
+from simclr_pytorch_distributed_tpu.ops.losses import (
+    cross_entropy_loss,
+    supcon_loss,
+)
+from simclr_pytorch_distributed_tpu.ops.metrics import topk_correct
 from simclr_pytorch_distributed_tpu.ops.pallas_loss import (
     fused_sharded_supcon_loss,
     fused_supcon_loss,
@@ -53,15 +58,51 @@ from simclr_pytorch_distributed_tpu.parallel.mesh import (
 from simclr_pytorch_distributed_tpu.train.state import TrainState
 
 
-# The step's full metric-dict key set (aux + learning_rate), sorted — the
+# The step's base metric-dict key set (aux + learning_rate), sorted — the
 # column order of the device-side metric ring (ops/metrics.MetricRing): the
 # jitted writer and the host reader both derive columns from this one tuple,
 # so a metric added to ``train_step`` without extending it fails loudly at
-# trace time instead of silently shifting columns.
+# trace time instead of silently shifting columns. Health/probe extensions
+# below are opt-in per run; :func:`metric_keys` derives the run's full set,
+# and the SAME derivation feeds the writer's trace-time assertion and the
+# host reader, so the two sides cannot diverge.
 METRIC_KEYS = (
     "learning_rate", "loss", "loss_l2reg", "loss_sec",
     "norm_mean", "norm_var", "record_norm_mean",
 )
+
+# Representation-health diagnostics (--health_freq > 0): computed INSIDE the
+# jitted update from the loss's own normalized embeddings and the step's
+# gradients, written into the same donated ring — zero new per-step D2H. On
+# non-health steps (step % health_freq != 0) the columns carry an all-NaN
+# sentinel row; host consumers (TB tags, the HealthMonitor, gauges) skip it.
+HEALTH_METRIC_KEYS = (
+    "health_align",      # mean positive-pair cosine (collapse -> 1.0)
+    "health_con_top1",   # contrastive top-1: positive is the argmax contrast
+    "health_eff_rank",   # exp-entropy of the d x d embedding covariance
+    "health_grad_norm",  # global gradient norm (divergence signal)
+    "health_neg_max",    # max negative-pair cosine
+    "health_neg_mean",   # mean negative-pair cosine (collapse -> 1.0)
+    "health_unif",       # Wang-Isola uniformity, log E exp(-2||z_i-z_j||^2)
+)
+
+# Online linear probe (--online_probe on): a detached classifier head trained
+# by the same compiled update on stop_gradient encoder features; its loss and
+# top-1 (percent, over both views) stream through the ring every step.
+ONLINE_PROBE_METRIC_KEYS = ("probe_loss", "probe_top1")
+
+
+def metric_keys(health: bool = False, online_probe: bool = False):
+    """The run's full sorted ring-key tuple. The drivers and the step builder
+    both call this with the SAME config bits, so a flag mismatch between the
+    writer and the TelemetrySession reader fails loudly at trace time
+    (MetricRing.write's key check) instead of silently shifting columns."""
+    keys = METRIC_KEYS
+    if health:
+        keys = keys + HEALTH_METRIC_KEYS
+    if online_probe:
+        keys = keys + ONLINE_PROBE_METRIC_KEYS
+    return tuple(sorted(keys))
 
 
 def epoch_position(step, steps_per_epoch: int):
@@ -78,6 +119,94 @@ def epoch_position(step, steps_per_epoch: int):
     (which would be an H2D transfer, docs/PERF.md) is needed.
     """
     return jax.lax.rem(step, jnp.int32(steps_per_epoch))
+
+
+def contrastive_health_metrics(emb: jax.Array, grads) -> dict:
+    """The :data:`HEALTH_METRIC_KEYS` diagnostics from one batch.
+
+    ``emb`` is the loss's OWN L2-normalized embedding matrix ``[2B, D]`` in
+    the view-major global row layout (rows ``[v1 of all samples; v2 of all
+    samples]``, so row ``i``'s positive sits at ``(i + B) % 2B``), passed out
+    of ``loss_fn``'s aux under ``stop_gradient`` — nothing here is a second
+    forward, and on the dense loss path the similarity matmul is the same
+    ``dot(emb, emb^T)`` HLO the loss already builds (XLA CSE-able). Runs only
+    on health steps: the caller gates it behind ``lax.cond`` on
+    ``step % health_freq``, so non-health steps pay neither the ``O((2B)^2)``
+    matmul nor the ``d x d`` eigendecomposition.
+
+    A collapsed representation (all embeddings equal) reads as: align -> 1,
+    neg_mean/neg_max -> 1, eff_rank -> 1, unif -> 0 (its maximum), con_top1
+    -> chance. A diverging one shows up first in ``grad_norm``.
+    """
+    n = emb.shape[0]
+    b = n // 2
+    sim = emb @ emb.T  # [2B, 2B] cosine (rows are unit-norm)
+    idx = jnp.arange(n)
+    pos_idx = (idx + b) % n
+    eye = idx[:, None] == idx[None, :]
+    pos = pos_idx[:, None] == idx[None, :]
+    neg = ~(eye | pos)
+    align = jnp.mean(jnp.sum(emb[:b] * emb[b:], axis=1))
+    neg_count = jnp.maximum(jnp.sum(neg.astype(jnp.float32)), 1.0)
+    neg_mean = jnp.sum(jnp.where(neg, sim, 0.0)) / neg_count
+    neg_max = jnp.max(jnp.where(neg, sim, -jnp.inf))
+    # contrastive top-1: is the positive the highest-similarity non-self row?
+    top1 = 100.0 * jnp.mean(
+        (jnp.argmax(jnp.where(eye, -jnp.inf, sim), axis=1) == pos_idx)
+        .astype(jnp.float32)
+    )
+    # Wang-Isola uniformity with t=2 over non-self pairs; ||z_i - z_j||^2 =
+    # 2 - 2*cos for unit rows, so the exponent is bounded in [-8, 0].
+    unif = jnp.log(
+        jnp.sum(jnp.where(eye, 0.0, jnp.exp(4.0 * sim - 4.0)))
+        / (n * (n - 1))
+    )
+    # effective rank = exp(entropy) of the normalized covariance spectrum
+    cov = emb.T @ emb / n
+    eig = jnp.clip(jnp.linalg.eigvalsh(cov), 0.0, None)
+    p = eig / jnp.maximum(jnp.sum(eig), 1e-12)
+    entropy = -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.maximum(p, 1e-12)), 0.0))
+    return {
+        "health_align": align,
+        "health_con_top1": top1,
+        "health_eff_rank": jnp.exp(entropy),
+        "health_grad_norm": optax.global_norm(grads),
+        "health_neg_max": neg_max,
+        "health_neg_mean": neg_mean,
+        "health_unif": unif,
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class OnlineProbe:
+    """The online linear probe's static pieces: a ``LinearClassifier`` head
+    and its (schedule-free SGD) optimizer. Built once per run by
+    :func:`build_online_probe`; the trainable state lives on
+    ``TrainState.probe_params`` / ``probe_opt_state``."""
+
+    classifier: Any
+    tx: optax.GradientTransformation
+
+
+def build_online_probe(model_name: str, feat_dim: int, n_cls: int,
+                       lr: float, momentum: float = 0.9, seed: int = 0):
+    """``(OnlineProbe, probe_params, probe_opt_state)`` for a run.
+
+    The head and recipe mirror the post-hoc probe (train/linear.py:
+    ``LinearClassifier`` + SGD momentum, zero weight decay) so the live
+    curve estimates the same quantity ``main_linear.py`` measures hours
+    later — the documented tolerance between the two is in
+    docs/OBSERVABILITY.md. Constant LR: the probe chases a moving encoder,
+    so the post-hoc step schedule has nothing to anneal against.
+    """
+    from simclr_pytorch_distributed_tpu.train.state import make_optimizer
+
+    classifier = LinearClassifier(model_name=model_name, num_classes=n_cls)
+    tx = make_optimizer(lr, momentum=momentum, weight_decay=0.0)
+    params = classifier.init(
+        jax.random.key(seed), jnp.zeros((2, feat_dim))
+    )["params"]
+    return OnlineProbe(classifier=classifier, tx=tx), params, tx.init(params)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,9 +234,21 @@ class SupConStepConfig:
     # axis — O((2B/P)^2) per-device memory for large global batches.
     # Resolved from the config's 'auto' upstream.
     loss_impl: str = "dense"
+    # representation-health diagnostics (HEALTH_METRIC_KEYS): computed every
+    # health_freq-th step inside a lax.cond (NaN sentinel rows otherwise),
+    # written into the same donated metric ring — zero new per-step D2H
+    health: bool = False
+    health_freq: int = 10
+    # online linear probe (ONLINE_PROBE_METRIC_KEYS): make_train_step must
+    # then be given the matching OnlineProbe spec, and the state must carry
+    # probe_params/probe_opt_state
+    online_probe: bool = False
 
 
-def two_view_forward(model, params, batch_stats, images: jax.Array, *, train: bool = True):
+def two_view_forward(
+    model, params, batch_stats, images: jax.Array, *,
+    train: bool = True, with_features: bool = False,
+):
     """Forward both views through the encoder+head as ONE batch.
 
     ``images`` is ``[B, 2, H, W, C]``. Views are flattened view-major —
@@ -115,17 +256,25 @@ def two_view_forward(model, params, batch_stats, images: jax.Array, *, train: bo
     reference assembles post-gather (``main_supcon.py:276-279``). Both views
     share one BN batch, matching the reference's ``cat([v1, v2])`` forward
     (``main_supcon.py:256,266``).
+
+    ``with_features=True`` routes through ``forward_with_features`` (one
+    backbone pass, models/heads.py) and the FIRST return element becomes the
+    ``(projection, encoder_features)`` pair — the online probe's input
+    without a second encoder forward. Default callers see the unchanged
+    2-tuple.
     """
     B = images.shape[0]
     flat = jnp.transpose(images, (1, 0, 2, 3, 4)).reshape((2 * B,) + images.shape[2:])
+    method = type(model).forward_with_features if with_features else None
     if train:
         feats, mutated = model.apply(
             {"params": params, "batch_stats": batch_stats},
-            flat, train=True, mutable=["batch_stats"],
+            flat, train=True, mutable=["batch_stats"], method=method,
         )
         return feats, mutated["batch_stats"]
     feats = model.apply(
-        {"params": params, "batch_stats": batch_stats}, flat, train=False
+        {"params": params, "batch_stats": batch_stats}, flat, train=False,
+        method=method,
     )
     return feats, batch_stats
 
@@ -136,14 +285,31 @@ def make_train_step(
     schedule: Callable,
     cfg: SupConStepConfig,
     mesh=None,
+    probe: Optional[OnlineProbe] = None,
 ) -> Callable:
     """Build the pure train step: (state, images[B,2,H,W,C], labels[B]) -> (state, metrics).
 
     ``mesh`` is required only for ``loss_impl='ring'`` (the shard_map needs an
     explicit mesh; dense/fused run as plain HLO that GSPMD partitions).
+
+    ``probe`` (an :class:`OnlineProbe`, required iff ``cfg.online_probe``)
+    adds the detached online-probe update: the classifier trains on
+    ``stop_gradient`` encoder features from the SAME backbone forward, so the
+    encoder/head/optimizer math is bit-identical probe-on vs probe-off
+    (tests/test_health.py proves it bitwise) and the probe costs one
+    ``[2B, feat_dim] x [feat_dim, n_cls]`` matmul pair per step.
     """
     if cfg.loss_impl == "ring" and mesh is None:
         raise ValueError("loss_impl='ring' needs the mesh passed to make_train_step")
+    if (probe is not None) != cfg.online_probe:
+        raise ValueError(
+            f"online_probe={cfg.online_probe} but probe spec "
+            f"{'missing' if probe is None else 'given'} — the step config "
+            "and the OnlineProbe must be built together"
+        )
+    expected_keys = metric_keys(health=cfg.health, online_probe=cfg.online_probe)
+    if cfg.health and cfg.health_freq < 1:
+        raise ValueError(f"health_freq must be >= 1, got {cfg.health_freq}")
     # 'fused' on a multi-device mesh routes through the shard_map-sharded
     # kernel (ops/pallas_loss.py fused_sharded_supcon_loss): anchors stay
     # sharded over 'data', the contrast side is all-gathered, and the logits
@@ -154,9 +320,19 @@ def make_train_step(
     )
 
     def loss_fn(params, state: TrainState, images, labels):
-        feats, new_batch_stats = two_view_forward(
-            model, params, state.batch_stats, images, train=True
-        )
+        probe_feats = None
+        if probe is not None:
+            (feats, enc_feats), new_batch_stats = two_view_forward(
+                model, params, state.batch_stats, images, train=True,
+                with_features=True,
+            )
+            # the probe's whole detachment contract: gradients CANNOT flow
+            # from the classifier back into the encoder
+            probe_feats = jax.lax.stop_gradient(enc_feats.astype(jnp.float32))
+        else:
+            feats, new_batch_stats = two_view_forward(
+                model, params, state.batch_stats, images, train=True
+            )
         feats = feats.astype(jnp.float32)
         B = images.shape[0]
 
@@ -265,8 +441,38 @@ def make_train_step(
             "loss_sec": loss_sec,
             "loss_l2reg": loss_l2reg,
         }
+        if cfg.health:
+            # the loss's OWN normalized, view-major embedding rows — the
+            # health diagnostics' input, detached so aux plumbing cannot
+            # perturb the gradient
+            aux["embeddings"] = jax.lax.stop_gradient(n_fea)
+        if probe is not None:
+            aux["probe_feats"] = probe_feats
         # grad-scale fidelity: DDP means over ngpu ranks (module docstring)
         return loss / cfg.grad_div, (aux, new_batch_stats)
+
+    def probe_update(state: TrainState, probe_feats, labels):
+        """One detached classifier step on the stop_gradient encoder
+        features of BOTH views (labels tiled view-major to match)."""
+        labels2 = jnp.concatenate([labels, labels])
+
+        def probe_loss_fn(pp):
+            logits = probe.classifier.apply({"params": pp}, probe_feats)
+            return cross_entropy_loss(logits, labels2), logits
+
+        (ploss, logits), pgrads = jax.value_and_grad(
+            probe_loss_fn, has_aux=True
+        )(state.probe_params)
+        pupdates, new_popt = probe.tx.update(
+            pgrads, state.probe_opt_state, state.probe_params
+        )
+        new_pparams = optax.apply_updates(state.probe_params, pupdates)
+        top1 = topk_correct(logits, labels2, ks=(1,))[1]
+        pmetrics = {
+            "probe_loss": ploss,
+            "probe_top1": 100.0 * top1.astype(jnp.float32) / labels2.shape[0],
+        }
+        return new_pparams, new_popt, pmetrics
 
     def train_step(
         state: TrainState, images: jax.Array, labels: jax.Array
@@ -277,13 +483,38 @@ def make_train_step(
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = dict(aux, learning_rate=jnp.asarray(schedule(state.step)))
-        assert tuple(sorted(metrics)) == METRIC_KEYS, sorted(metrics)
+        metrics.pop("embeddings", None)
+        metrics.pop("probe_feats", None)
+        replace_kwargs = {}
+        if cfg.health:
+            # lax.cond, not where: the false branch must SKIP the O((2B)^2)
+            # similarity matmul and the d x d eigendecomposition at runtime,
+            # not just mask their results — non-health steps pay nothing
+            metrics.update(jax.lax.cond(
+                state.step % cfg.health_freq == 0,
+                lambda ops: contrastive_health_metrics(*ops),
+                lambda ops: {
+                    k: jnp.full((), jnp.nan, jnp.float32)
+                    for k in HEALTH_METRIC_KEYS
+                },
+                (aux["embeddings"], grads),
+            ))
+        if probe is not None:
+            new_pparams, new_popt, pmetrics = probe_update(
+                state, aux["probe_feats"], labels
+            )
+            metrics.update(pmetrics)
+            replace_kwargs.update(
+                probe_params=new_pparams, probe_opt_state=new_popt
+            )
+        assert tuple(sorted(metrics)) == expected_keys, sorted(metrics)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
             batch_stats=new_batch_stats,
             opt_state=new_opt_state,
             record_norm_mean=aux["record_norm_mean"],
+            **replace_kwargs,
         )
         return new_state, metrics
 

@@ -14,7 +14,12 @@ Reference semantics being covered (SURVEY.md §3.5):
 
 Layout: ``{name}/model`` holds {params, batch_stats} and ``{name}/train`` holds
 {opt_state, step, record_norm_mean}, so model-only consumers (probe, warm-start)
-never need the optimizer's tree structure.
+never need the optimizer's tree structure. Runs with the ONLINE probe
+(``--online_probe on``, train/supcon_step.py) additionally write
+``{name}/probe`` holding {probe_params, probe_opt_state} — its OWN payload so
+probe-off consumers (warm start, the post-hoc linear probe, serving) never
+see it, and a probe-on resume of a probe-off checkpoint degrades to a fresh
+probe init with a warning instead of failing the whole restore.
 
 Improvement over the reference: ``restore_checkpoint`` brings back the FULL
 train state so a crashed run resumes instead of restarting (the reference has no
@@ -204,12 +209,12 @@ def save_checkpoint(
             # backend.
             state = jit_copy_tree(state)
         path = os.path.abspath(os.path.join(save_folder, name))
-        c1 = _save_tree(
+        ckptrs = [_save_tree(
             os.path.join(path, "model"),
             {"params": state.params, "batch_stats": state.batch_stats},
             block=block,
-        )
-        c2 = _save_tree(
+        )]
+        ckptrs.append(_save_tree(
             os.path.join(path, "train"),
             {
                 "opt_state": state.opt_state,
@@ -217,7 +222,18 @@ def save_checkpoint(
                 "record_norm_mean": state.record_norm_mean,
             },
             block=block,
-        )
+        ))
+        if getattr(state, "probe_params", None) is not None:
+            # the online probe's own payload (module docstring): restored
+            # only by probe-on resumes, invisible to model/train consumers
+            ckptrs.append(_save_tree(
+                os.path.join(path, "probe"),
+                {
+                    "probe_params": state.probe_params,
+                    "probe_opt_state": state.probe_opt_state,
+                },
+                block=block,
+            ))
         meta = {
             **(extra_meta or {}),
             "epoch": epoch, "step_in_epoch": int(step_in_epoch),
@@ -227,7 +243,7 @@ def save_checkpoint(
         if block:
             _write_meta(path, meta)
         else:
-            _PENDING.append(([c1, c2], path, meta))
+            _PENDING.append((ckptrs, path, meta))
     return path
 
 
@@ -309,6 +325,28 @@ def restore_checkpoint(path: str, abstract_state) -> Tuple[Any, dict]:
         opt_state=train["opt_state"],
         record_norm_mean=train["record_norm_mean"],
     )
+    if getattr(abstract_state, "probe_params", None) is not None:
+        probe_dir = os.path.join(path, "probe")
+        if os.path.isdir(probe_dir):
+            probe = _restore_tree(
+                probe_dir,
+                _abstract({"probe_params": abstract_state.probe_params,
+                           "probe_opt_state": abstract_state.probe_opt_state}),
+            )
+            state = state.replace(
+                probe_params=probe["probe_params"],
+                probe_opt_state=probe["probe_opt_state"],
+            )
+        else:
+            # probe turned on across the resume: the encoder trajectory is
+            # intact either way, so degrade to the fresh probe init instead
+            # of refusing the restore (the probe re-converges in steps)
+            import logging
+
+            logging.warning(
+                "checkpoint %s has no online-probe payload; the probe "
+                "restarts from its fresh init", path,
+            )
     # Re-own every restored buffer through the shared jitted copy: orbax
     # hands back arrays whose host memory the XLA allocator does not own,
     # and the train steps DONATE their input state — donating a

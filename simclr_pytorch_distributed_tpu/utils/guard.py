@@ -21,13 +21,28 @@ Policy (``--nan_policy``): what the driver DOES about it.
   rollbacks bound the self-healing — a run whose loss keeps exploding at
   1/8th of the recipe LR has a real bug and aborts like before.
 
+Representation health (``--health_policy``) is the third leg: the
+:class:`HealthMonitor` evaluates the windowed on-device diagnostics
+(train/supcon_step.HEALTH_METRIC_KEYS) the metric ring delivers at flush
+boundaries and turns a collapsed or diverging representation — which a
+finite loss hides completely — into flight-recorder events (``warn``) or a
+typed :class:`RepresentationHealthError` abort. Unlike a NaN, a health
+abort is NEVER rolled back: collapse lives in the weights, so replaying the
+epoch from the boundary backup at half the LR just re-detects it
+(docs/RESILIENCE.md, precedence note).
+
 Preemption (SIGTERM/SIGINT) is the other half of the failure model and lives
 in utils/preempt.py; docs/RESILIENCE.md has the full matrix.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import logging
 import math
+from collections import deque
+
+from simclr_pytorch_distributed_tpu.utils import tracing
 
 # Each rollback halves the LR: strong enough that two rollbacks tame a
 # warmup/batch-order spike, gentle enough that one spurious NaN doesn't
@@ -84,3 +99,187 @@ class FailurePolicy:
         self.rollbacks += 1
         self.lr_scale *= self.lr_mult
         return True
+
+
+# ------------------------------------------------------- representation health
+
+# health samples the detector's rolling window holds; at the default
+# health_freq=10 this is ~80 steps of history — long enough that one odd
+# batch cannot trip a verdict, short enough that a real collapse is caught
+# within a few print_freq windows
+HEALTH_WINDOW = 8
+
+
+class RepresentationHealthError(RuntimeError):
+    """Raised (under ``--health_policy abort``) when the windowed
+    representation diagnostics say the run is collapsed or diverging."""
+
+    def __init__(self, findings, step: int):
+        findings = list(findings)
+        super().__init__(
+            f"representation health alarm at global step {step}: "
+            + "; ".join(findings)
+        )
+        self.findings = findings
+        self.step = step
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthThresholds:
+    """Detector bars over WINDOWED means (see :class:`HealthMonitor`).
+
+    Scales are absolute properties of unit-norm embeddings, not tuned per
+    run: a truly collapsed representation sits at eff_rank == 1.0 and
+    align == neg_mean == 1.0 exactly, while even a random-init encoder
+    spreads its projector outputs to eff_rank >> 2 with negatives near 0 —
+    so the defaults fire only on the degenerate regime, never on the
+    (normal) early-training plateau. ``grad_norm_max`` is off by default:
+    healthy gradient scales are recipe-specific, and the NaN guard already
+    catches the terminal form of divergence.
+    """
+
+    eff_rank_min: float = 2.0
+    align_max: float = 0.995
+    neg_mean_max: float = 0.995
+    grad_norm_max: float = 0.0  # 0 = divergence bar disabled
+    min_samples: int = 2
+
+
+class HealthMonitor:
+    """Windowed collapse/divergence detector over the ring's health samples.
+
+    The drivers' flush-boundary ``consume`` job feeds it every fetched row
+    (:meth:`ingest`, running on the telemetry thread): all-NaN health
+    columns — the non-health-step sentinel rows ``lax.cond`` writes — are
+    skipped, finite samples enter a rolling window, and the window means
+    are evaluated against :class:`HealthThresholds`. Each ingest with new
+    samples emits one ``health_window`` event (the means — the post-hoc
+    metric stream ``scripts/health_report.py`` reads) on the ``health``
+    track; a verdict additionally emits a ``health_alarm`` event and, under
+    ``policy='abort'``, raises :class:`RepresentationHealthError` — which
+    the telemetry executor stores and the boundary's COLLECTIVE
+    ``check_failures_global`` re-raises on every host as failure code 3
+    (utils/telemetry.py), the same deterministic exit discipline as the NaN
+    check. Host-only throughout: no device sync, no transfer.
+    """
+
+    def __init__(self, policy: str = "warn", thresholds: HealthThresholds = None,
+                 window: int = HEALTH_WINDOW):
+        if policy not in ("warn", "abort"):
+            raise ValueError(f"unknown health_policy {policy!r}")
+        self.policy = policy
+        self.thresholds = thresholds if thresholds is not None else HealthThresholds()
+        self._window: "deque[dict]" = deque(maxlen=window)
+        self.samples = 0  # real health samples ingested (sentinels excluded)
+        self.alarms = 0
+        self.last_means: dict = {}
+        # non-finite values seen inside REAL samples ({key: count}): an inf
+        # gradient norm or a NaN eigen-spectrum is itself a divergence
+        # finding — window_means averages finite values only, so these are
+        # tracked here rather than through the means. ``_nonfinite_surfaced``
+        # is how many have already been reported: the delta surfaces at the
+        # next evaluation (independent of min_samples — one inf is a hard
+        # signal, not a windowed statistic), and never re-alarms.
+        self.nonfinite_keys: dict = {}
+        self._nonfinite_surfaced = 0
+
+    def observe(self, metrics: dict, step: int) -> bool:
+        """Ingest one fetched ring row; returns True iff it carried a real
+        (non-sentinel) health sample."""
+        sample = {
+            k: float(v) for k, v in metrics.items()
+            if k.startswith(("health_", "probe_"))
+        }
+        health_vals = [v for k, v in sample.items() if k.startswith("health_")]
+        if not health_vals or all(math.isnan(v) for v in health_vals):
+            return False  # sentinel row: step % health_freq != 0
+        for k, v in sample.items():
+            if not math.isfinite(v):
+                self.nonfinite_keys[k] = self.nonfinite_keys.get(k, 0) + 1
+        sample["step"] = int(step)
+        self._window.append(sample)
+        self.samples += 1
+        return True
+
+    def window_means(self) -> dict:
+        """Mean of each finite metric over the rolling window (``step`` is
+        the window's LAST step, not averaged)."""
+        if not self._window:
+            return {}
+        keys = set().union(*(s.keys() for s in self._window)) - {"step"}
+        means = {}
+        for k in sorted(keys):
+            vals = [s[k] for s in self._window if k in s and math.isfinite(s[k])]
+            if vals:
+                means[k] = sum(vals) / len(vals)
+        means["step"] = self._window[-1]["step"]
+        return means
+
+    def verdicts(self, means: dict):
+        """The findings for one window-mean dict (pure; tested directly)."""
+        t = self.thresholds
+        findings = []
+        eff = means.get("health_eff_rank")
+        if eff is not None and eff < t.eff_rank_min:
+            findings.append(
+                f"collapse: embedding effective rank {eff:.3g} < "
+                f"{t.eff_rank_min:g}"
+            )
+        align = means.get("health_align")
+        neg = means.get("health_neg_mean")
+        if (align is not None and neg is not None
+                and align > t.align_max and neg > t.neg_mean_max):
+            findings.append(
+                f"collapse: positives ({align:.4f}) and negatives "
+                f"({neg:.4f}) both ~1 — all embeddings identical"
+            )
+        gnorm = means.get("health_grad_norm")
+        if gnorm is not None and t.grad_norm_max and gnorm > t.grad_norm_max:
+            findings.append(
+                f"divergence: gradient norm {gnorm:.3g} > {t.grad_norm_max:g}"
+            )
+        return findings
+
+    def ingest(self, rows, gauges=None) -> list:
+        """One flush window's worth of ``(step, metrics)`` rows. Returns the
+        findings (empty = healthy); raises under ``policy='abort'``."""
+        fresh = 0
+        for step, metrics in rows:
+            fresh += self.observe(metrics, step)
+        if not fresh:
+            return []
+        means = self.window_means()
+        self.last_means = means
+        if gauges is not None:
+            gauges.set(**{k: v for k, v in means.items() if k != "step"})
+        tracing.event(
+            "health_window", track="health",
+            **{k: round(v, 6) if isinstance(v, float) else v
+               for k, v in means.items()},
+        )
+        # non-finite values surface regardless of min_samples (a single inf
+        # gradient norm is a hard signal, not a windowed statistic); the
+        # surfaced counter defers — never drops — ones that landed earlier
+        findings = []
+        nonfinite_total = sum(self.nonfinite_keys.values())
+        if nonfinite_total > self._nonfinite_surfaced:
+            self._nonfinite_surfaced = nonfinite_total
+            findings.append(
+                "divergence: non-finite health metrics "
+                f"{sorted(self.nonfinite_keys)}"
+            )
+        if len(self._window) >= self.thresholds.min_samples:
+            findings = self.verdicts(means) + findings
+        if findings:
+            self.alarms += 1
+            tracing.event(
+                "health_alarm", track="health", step=means["step"],
+                policy=self.policy, findings=findings,
+            )
+            logging.warning(
+                "representation health alarm at step %d (policy=%s): %s",
+                means["step"], self.policy, "; ".join(findings),
+            )
+            if self.policy == "abort":
+                raise RepresentationHealthError(findings, means["step"])
+        return findings

@@ -41,7 +41,11 @@ class RunObservability:
       the flush boundary (via ``TelemetrySession``); ``None`` unless
       ``--watchdog_secs > 0``;
     - ``gauges`` + the ``--metrics_port`` sidecar server; ``None`` when
-      the port is 0.
+      the port is 0;
+    - ``health`` — a :class:`guard.HealthMonitor` (the windowed
+      collapse/divergence detector fed by the flush-boundary consume jobs)
+      when the config carries health flags with ``health_freq > 0``
+      (pretrain only); ``None`` otherwise.
     """
 
     def __init__(self, cfg, name: str):
@@ -54,6 +58,13 @@ class RunObservability:
             self.watchdog = tracing.StallWatchdog(
                 cfg.watchdog_secs, cfg.save_folder, recorder=self.recorder,
                 name=name,
+            )
+        self.health = None
+        if getattr(cfg, "health_freq", 0) > 0:
+            from simclr_pytorch_distributed_tpu.utils.guard import HealthMonitor
+
+            self.health = HealthMonitor(
+                policy=getattr(cfg, "health_policy", "warn")
             )
         self.gauges = self.sidecar = None
         if cfg.metrics_port:

@@ -168,7 +168,10 @@ class TrainerGauges:
     ``beat(step)`` stamps the boundary clock (wired through
     ``TelemetrySession.flush_boundary`` — the same host-visible point the
     stall watchdog watches); ``set()`` records auxiliary gauges (epoch,
-    in-flight windows); ``register()`` attaches lazy callables evaluated at
+    in-flight windows, and — on health-enabled pretrain runs — the
+    ``health_*``/``probe_*`` window means the HealthMonitor stamps from the
+    flush consume job, so a scraper reads representation quality next to
+    liveness); ``register()`` attaches lazy callables evaluated at
     scrape time (pending checkpoint saves). ``last_boundary_age_seconds``
     is THE liveness signal: a scraper sees it climb monotonically exactly
     when the run is wedged.

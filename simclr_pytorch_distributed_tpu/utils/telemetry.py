@@ -364,12 +364,19 @@ class TelemetrySession:
         self.executor.close()
 
     def _failure_code(self) -> int:
-        """0 = clean, 1 = non-finite loss, 2 = any other flush failure."""
+        """0 = clean, 1 = non-finite loss, 2 = any other flush failure,
+        3 = representation-health abort (guard.HealthMonitor under
+        ``--health_policy abort``)."""
         exc = self.executor._exc
         if exc is None:
             return 0
-        from simclr_pytorch_distributed_tpu.utils.guard import NonFiniteLossError
+        from simclr_pytorch_distributed_tpu.utils.guard import (
+            NonFiniteLossError,
+            RepresentationHealthError,
+        )
 
+        if isinstance(exc, RepresentationHealthError):
+            return 3
         return 1 if isinstance(exc, NonFiniteLossError) else 2
 
     def check_failures_global(self, step_hint: int = 0) -> None:
@@ -386,8 +393,13 @@ class TelemetrySession:
         and they must leave through the SAME exception type, or the failure
         POLICY diverges across the job (host 0 rolling back while a peer
         aborts is a collective mismatch). The allgathered failure CODE picks
-        that type deterministically: a non-NaN flush failure (a TB-volume
-        ``IOError``, a D2H fault) outranks a non-finite loss and exits as
+        that type deterministically, by max over hosts: a
+        representation-health abort (code 3, ``--health_policy abort``)
+        outranks everything — all three codes end the run, but the health
+        verdict carries the actionable finding and is never subject to the
+        NaN policy (rolling back a collapsed representation just re-detects
+        it); a non-NaN flush failure (code 2: a TB-volume ``IOError``, a D2H
+        fault) outranks a non-finite loss and exits as
         :class:`TelemetryFlushError` — it must NOT trigger the NaN policy,
         else ``--nan_policy rollback`` would discard clean epochs for a disk
         error; only a pure non-finite-loss window exits as
@@ -417,7 +429,10 @@ class TelemetrySession:
         tracing.event(
             "flush_failure", track="main:guard", code=code, step=step_hint
         )
-        from simclr_pytorch_distributed_tpu.utils.guard import NonFiniteLossError
+        from simclr_pytorch_distributed_tpu.utils.guard import (
+            NonFiniteLossError,
+            RepresentationHealthError,
+        )
 
         try:
             self.drain()  # re-raises this host's own exception when present
@@ -429,7 +444,13 @@ class TelemetrySession:
             # policy across hosts — e.g. a late TB IOError aborting here
             # while the NaN peers roll back and re-enter the epoch loop's
             # collectives without us.
-            if code >= 2:
+            if code == 3:
+                if isinstance(e, RepresentationHealthError):
+                    raise
+                raise RepresentationHealthError(
+                    ["peer reported a representation health alarm"], step_hint
+                ) from e
+            if code == 2:
                 raise TelemetryFlushError(
                     f"telemetry flush failed near global step {step_hint}"
                 ) from e
@@ -441,7 +462,11 @@ class TelemetrySession:
                 raise
             raise NonFiniteLossError(float("nan"), step_hint) from e
         # skew guard: this host's own windows were clean but a peer flagged
-        if code >= 2:
+        if code == 3:
+            raise RepresentationHealthError(
+                ["peer reported a representation health alarm"], step_hint
+            )
+        if code == 2:
             raise TelemetryFlushError(
                 f"peer telemetry flush failed near global step {step_hint}"
             )

@@ -1,0 +1,706 @@
+"""Training-health observability: on-device diagnostics, the online probe,
+and the collapse detector (train/supcon_step.py, utils/guard.py,
+scripts/health_report.py).
+
+The load-bearing claims are tested mechanically, not assumed:
+
+- RING EXTENSION: the health/probe columns extend the metric ring without
+  corrupting any existing key's value stream, and a writer/reader key
+  mismatch still fails loudly at trace time.
+- DETACHMENT: encoder + projection-head params (and BN stats, and the
+  optimizer state) after N steps are BITWISE identical with the online
+  probe on vs off — ``stop_gradient`` really isolates it — and a resume
+  restores the probe's own state.
+- COLLAPSE: a degenerate constant-embedding run trips the windowed detector
+  through the REAL driver; ``--health_policy abort`` exits with the typed
+  ``RepresentationHealthError`` via the collective failure code (3), and
+  the flight recorder holds the ``health_alarm`` event.
+- ZERO-SYNC: a real supcon epoch with health metrics + the online probe
+  enabled performs EXACTLY the PR-4/PR-5 transfer contract — one ring D2H
+  per window and one index upload per epoch — counted through the
+  injectable ``device_get``/``index_put`` hooks, same as PR 7's recorder
+  proof.
+"""
+
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu.models import MODEL_DICT, SupConResNet
+from simclr_pytorch_distributed_tpu.ops.metrics import MetricRing
+from simclr_pytorch_distributed_tpu.train.state import (
+    create_train_state,
+    make_optimizer,
+)
+from simclr_pytorch_distributed_tpu.train import supcon_step
+from simclr_pytorch_distributed_tpu.train.supcon_step import (
+    HEALTH_METRIC_KEYS,
+    METRIC_KEYS,
+    ONLINE_PROBE_METRIC_KEYS,
+    SupConStepConfig,
+    build_online_probe,
+    contrastive_health_metrics,
+    make_train_step,
+    metric_keys,
+)
+from simclr_pytorch_distributed_tpu.utils import tracing
+from simclr_pytorch_distributed_tpu.utils.guard import (
+    HealthMonitor,
+    HealthThresholds,
+    RepresentationHealthError,
+)
+
+pytestmark = pytest.mark.health
+
+SIZE = 8
+
+
+# ------------------------------------------------- the diagnostics themselves
+
+
+def _healthy_sample():
+    """All-NaN-free sample at plausible healthy values."""
+    return {
+        "health_align": 0.5, "health_con_top1": 30.0, "health_eff_rank": 12.0,
+        "health_grad_norm": 5.0, "health_neg_max": 0.7,
+        "health_neg_mean": 0.4, "health_unif": -2.0,
+        "probe_loss": 2.0, "probe_top1": 25.0,
+    }
+
+
+def test_health_metrics_on_structured_embeddings():
+    """B orthogonal directions, each duplicated across the two views:
+    positives perfectly aligned, negatives orthogonal, every anchor's argmax
+    is its positive, and the spectrum spreads over B dimensions."""
+    b, d = 8, 16
+    base = np.eye(d, dtype=np.float32)[:b]
+    emb = jnp.asarray(np.concatenate([base, base]))  # view-major [2B, D]
+    m = jax.device_get(contrastive_health_metrics(emb, {"w": jnp.zeros(3)}))
+    assert m["health_align"] == pytest.approx(1.0)
+    assert m["health_con_top1"] == pytest.approx(100.0)
+    assert m["health_neg_mean"] == pytest.approx(0.0, abs=1e-6)
+    assert m["health_neg_max"] == pytest.approx(0.0, abs=1e-6)
+    assert m["health_eff_rank"] == pytest.approx(b, rel=1e-3)
+    assert m["health_grad_norm"] == pytest.approx(0.0)
+    assert m["health_unif"] < -0.5  # spread embeddings: well below the max
+    assert set(m) == set(HEALTH_METRIC_KEYS)
+
+
+def test_health_metrics_on_collapsed_embeddings():
+    """Constant embeddings — the degenerate regime the detector exists for:
+    align, neg_mean, neg_max -> 1; eff_rank -> 1; uniformity -> 0 (its
+    maximum)."""
+    emb = jnp.ones((16, 8), jnp.float32) / jnp.sqrt(8.0)
+    m = jax.device_get(
+        contrastive_health_metrics(emb, {"w": jnp.full((2,), 3.0)})
+    )
+    assert m["health_align"] == pytest.approx(1.0)
+    assert m["health_neg_mean"] == pytest.approx(1.0)
+    assert m["health_neg_max"] == pytest.approx(1.0)
+    assert m["health_eff_rank"] == pytest.approx(1.0, abs=1e-3)
+    assert m["health_unif"] == pytest.approx(0.0, abs=1e-5)
+    assert m["health_grad_norm"] == pytest.approx(math.sqrt(18.0))
+
+
+def _tiny_step(online_probe=False, health=False, health_freq=1, n_cls=4):
+    model = SupConResNet(model_name="resnet10", feat_dim=16)
+    tx = make_optimizer(0.1)
+    cfg = SupConStepConfig(
+        method="SimCLR", steps_per_epoch=4, online_probe=online_probe,
+        health=health, health_freq=health_freq,
+    )
+    state = create_train_state(
+        model, tx, jax.random.key(0), jnp.zeros((2, SIZE, SIZE, 3))
+    )
+    probe = None
+    if online_probe:
+        probe, pp, po = build_online_probe(
+            "resnet10", MODEL_DICT["resnet10"][1], n_cls, lr=0.1,
+        )
+        state = state.replace(probe_params=pp, probe_opt_state=po)
+    step = jax.jit(make_train_step(model, tx, lambda s: 0.1, cfg, probe=probe))
+    return step, state
+
+
+def _batch(key, b=8, n_cls=4):
+    images = jax.random.uniform(key, (b, 2, SIZE, SIZE, 3))
+    labels = jnp.arange(b) % n_cls
+    return images, labels
+
+
+def test_health_cadence_nan_sentinel_off_steps():
+    """health_freq=2: steps 0 and 2 carry real diagnostics, step 1 the
+    all-NaN sentinel row — and the base metrics stay finite throughout."""
+    step, state = _tiny_step(health=True, health_freq=2)
+    images, labels = _batch(jax.random.key(1))
+    rows = []
+    for _ in range(3):
+        state, metrics = step(state, images, labels)
+        rows.append(jax.device_get(metrics))
+    for i, m in enumerate(rows):
+        assert set(m) == set(metric_keys(health=True))
+        assert math.isfinite(m["loss"])
+        health_vals = [float(m[k]) for k in HEALTH_METRIC_KEYS]
+        if i % 2 == 0:
+            assert all(math.isfinite(v) for v in health_vals), (i, m)
+        else:
+            assert all(math.isnan(v) for v in health_vals), (i, m)
+
+
+# ----------------------------------------------- ring key-extension contract
+
+
+def test_metric_keys_derivation_is_sorted_and_superset():
+    base = metric_keys()
+    assert base == tuple(sorted(METRIC_KEYS))
+    full = metric_keys(health=True, online_probe=True)
+    assert set(full) == set(METRIC_KEYS) | set(HEALTH_METRIC_KEYS) | set(
+        ONLINE_PROBE_METRIC_KEYS
+    )
+    assert list(full) == sorted(full)
+
+
+def test_ring_extension_preserves_existing_key_streams():
+    """Adding the health/probe columns must not corrupt any pre-existing
+    key's value stream: the same (key -> value) writes resolve identically
+    through the base ring and the extended ring."""
+    values = {k: float(i + 1) for i, k in enumerate(METRIC_KEYS)}
+    extended_values = dict(values)
+    extended_values.update(
+        {k: 100.0 + i for i, k in enumerate(HEALTH_METRIC_KEYS)}
+    )
+    extended_values.update(
+        {k: 200.0 + i for i, k in enumerate(ONLINE_PROBE_METRIC_KEYS)}
+    )
+    for keys, metrics in (
+        (metric_keys(), values),
+        (metric_keys(health=True, online_probe=True), extended_values),
+    ):
+        ring = MetricRing(4, keys)
+        buf = ring.init_buffer()
+        buf = ring.write(
+            buf, {k: jnp.float32(v) for k, v in metrics.items()}, 0
+        )
+        ring.append("i", 0)
+        (_, resolved), = ring.resolve(buf, ring.take_window())
+        for k, v in values.items():  # the BASE keys, under both layouts
+            assert resolved[k] == v, (k, keys)
+
+
+def test_ring_key_mismatch_fails_loudly_at_trace_time():
+    """A writer whose metric dict doesn't match the ring's key set must
+    raise during TRACING (where the write happens), not silently shift
+    columns — in both directions (missing and extra keys)."""
+    ring = MetricRing(4, metric_keys(health=True))
+    base_only = {k: jnp.float32(0) for k in METRIC_KEYS}
+
+    with pytest.raises(ValueError, match="metric keys"):
+        ring.write(ring.init_buffer(), base_only, 0)
+
+    # and inside an actual jit trace (the drivers' path)
+    def traced(buf):
+        return ring.write(buf, base_only, 0)
+
+    with pytest.raises(ValueError, match="metric keys"):
+        jax.jit(traced)(ring.init_buffer())
+
+    narrow_ring = MetricRing(4, METRIC_KEYS)
+    extended = {
+        k: jnp.float32(0) for k in metric_keys(health=True)
+    }
+    with pytest.raises(ValueError, match="metric keys"):
+        narrow_ring.write(narrow_ring.init_buffer(), extended, 0)
+
+
+def test_step_and_probe_spec_must_agree():
+    model = SupConResNet(model_name="resnet10", feat_dim=16)
+    tx = make_optimizer(0.1)
+    cfg_on = SupConStepConfig(method="SimCLR", online_probe=True)
+    with pytest.raises(ValueError, match="online_probe"):
+        make_train_step(model, tx, lambda s: 0.1, cfg_on, probe=None)
+    probe, _, _ = build_online_probe("resnet10", 512, 4, lr=0.1)
+    cfg_off = SupConStepConfig(method="SimCLR", online_probe=False)
+    with pytest.raises(ValueError, match="online_probe"):
+        make_train_step(model, tx, lambda s: 0.1, cfg_off, probe=probe)
+
+
+# ------------------------------------------------------- probe detachment
+
+
+def test_probe_detachment_bitwise_and_metrics():
+    """The whole detachment contract: N steps with the probe ON produce
+    BITWISE identical encoder+head params, BN stats, and optimizer state as
+    the probe-OFF run on the same data — stop_gradient really isolates the
+    probe — while the probe itself trains (its params move and its metrics
+    stream)."""
+    step_off, state_off = _tiny_step(online_probe=False)
+    step_on, state_on = _tiny_step(online_probe=True)
+    probe_init = jax.device_get(state_on.probe_params)
+    images, labels = _batch(jax.random.key(2))
+    for _ in range(3):
+        state_off, m_off = step_off(state_off, images, labels)
+        state_on, m_on = step_on(state_on, images, labels)
+
+    def assert_bitwise(a, b):
+        ja, jb = jax.device_get(a), jax.device_get(b)
+        flat_a, _ = jax.tree.flatten(ja)
+        flat_b, treedef = jax.tree.flatten(jb)
+        assert len(flat_a) == len(flat_b)
+        for xa, xb in zip(flat_a, flat_b):
+            np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+    assert_bitwise(state_off.params, state_on.params)
+    assert_bitwise(state_off.batch_stats, state_on.batch_stats)
+    assert_bitwise(state_off.opt_state, state_on.opt_state)
+    # the probe is real training, not a no-op rider
+    moved = jax.tree.map(
+        lambda a, b: not np.array_equal(np.asarray(a), np.asarray(b)),
+        probe_init, jax.device_get(state_on.probe_params),
+    )
+    assert any(jax.tree.leaves(moved))
+    got = jax.device_get(m_on)
+    assert math.isfinite(got["probe_loss"])
+    assert 0.0 <= got["probe_top1"] <= 100.0
+    assert set(m_on) == set(metric_keys(online_probe=True))
+    assert set(m_off) == set(metric_keys())
+
+
+def test_checkpoint_roundtrip_restores_probe_state(tmp_path):
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    step, state = _tiny_step(online_probe=True)
+    images, labels = _batch(jax.random.key(3))
+    state, _ = step(state, images, labels)
+    saved = jax.device_get(
+        {"p": state.probe_params, "o": state.probe_opt_state}
+    )
+    save_checkpoint(str(tmp_path), "ckpt", state, epoch=1)
+
+    _, abstract = _tiny_step(online_probe=True)
+    restored, meta = restore_checkpoint(str(tmp_path / "ckpt"), abstract)
+    got = jax.device_get(
+        {"p": restored.probe_params, "o": restored.probe_opt_state}
+    )
+    for a, b in zip(jax.tree.leaves(saved), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(restored.step) == 1
+
+    # a probe-OFF resume of the probe-on checkpoint ignores the payload
+    _, abstract_off = _tiny_step(online_probe=False)
+    restored_off, _ = restore_checkpoint(str(tmp_path / "ckpt"), abstract_off)
+    assert restored_off.probe_params is None
+
+
+def test_probe_on_resume_of_probe_off_checkpoint_degrades(tmp_path, caplog):
+    """Turning the probe ON across a resume keeps the encoder trajectory
+    and restarts the probe from its fresh init, with a warning."""
+    import logging
+
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    step, state = _tiny_step(online_probe=False)
+    images, labels = _batch(jax.random.key(4))
+    state, _ = step(state, images, labels)
+    save_checkpoint(str(tmp_path), "ckpt", state, epoch=1)
+
+    _, abstract_on = _tiny_step(online_probe=True)
+    fresh = jax.device_get(abstract_on.probe_params)
+    with caplog.at_level(logging.WARNING):
+        restored, _ = restore_checkpoint(str(tmp_path / "ckpt"), abstract_on)
+    assert "no online-probe payload" in caplog.text
+    for a, b in zip(
+        jax.tree.leaves(fresh), jax.tree.leaves(jax.device_get(restored.probe_params))
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------------------------------------- the detector
+
+
+def test_monitor_skips_sentinel_rows_and_windows_means():
+    mon = HealthMonitor("warn")
+    sentinel = {k: float("nan") for k in HEALTH_METRIC_KEYS}
+    assert not mon.observe(sentinel, 1)
+    assert mon.samples == 0
+    assert mon.observe(_healthy_sample(), 2)
+    s2 = dict(_healthy_sample(), health_align=0.7)
+    assert mon.observe(s2, 4)
+    means = mon.window_means()
+    assert means["health_align"] == pytest.approx(0.6)
+    assert means["step"] == 4
+
+
+def test_monitor_warn_policy_emits_events_and_counts_alarms():
+    rec = tracing.FlightRecorder(clock=lambda: 0.0)
+    tracing.install(rec)
+    try:
+        mon = HealthMonitor("warn")
+        collapsed = dict(
+            _healthy_sample(), health_align=1.0, health_neg_mean=1.0,
+            health_eff_rank=1.0,
+        )
+        findings = mon.ingest([(10, collapsed), (12, collapsed)])
+    finally:
+        tracing.uninstall()
+    assert findings and mon.alarms == 1
+    names = [e["name"] for e in rec.snapshot()]
+    assert "health_window" in names and "health_alarm" in names
+    alarm = [e for e in rec.snapshot() if e["name"] == "health_alarm"][0]
+    assert alarm["track"] == "health" and alarm["args"]["findings"]
+
+
+def test_monitor_abort_policy_raises_typed_error():
+    mon = HealthMonitor("abort")
+    collapsed = dict(_healthy_sample(), health_eff_rank=1.2)
+    with pytest.raises(RepresentationHealthError, match="effective rank"):
+        mon.ingest([(1, collapsed), (2, collapsed)])
+
+
+def test_monitor_min_samples_guard_and_gauges():
+    from simclr_pytorch_distributed_tpu.utils import prom
+
+    mon = HealthMonitor(
+        "abort", thresholds=HealthThresholds(min_samples=3)
+    )
+    collapsed = dict(_healthy_sample(), health_eff_rank=1.0)
+    gauges = prom.TrainerGauges(clock=lambda: 0.0)
+    assert mon.ingest([(1, collapsed)], gauges=gauges) == []  # 1 < 3
+    assert gauges.collect()["health_eff_rank"] == pytest.approx(1.0)
+    assert mon.ingest([(2, collapsed)], gauges=gauges) == []  # 2 < 3
+    with pytest.raises(RepresentationHealthError):
+        mon.ingest([(3, collapsed)], gauges=gauges)
+
+
+def test_monitor_nonfinite_health_value_is_divergence():
+    mon = HealthMonitor("warn")
+    diverging = dict(_healthy_sample(), health_grad_norm=float("inf"))
+    findings = mon.ingest([(1, diverging), (2, _healthy_sample())])
+    assert any("non-finite" in f for f in findings)
+    # ...and it never re-alarms for the SAME non-finite events
+    assert mon.ingest([(3, _healthy_sample())]) == []
+
+
+def test_monitor_nonfinite_surfaces_below_min_samples():
+    """A non-finite health value is a hard signal: it must surface even
+    while the window is below min_samples (one health sample per flush is
+    the print_freq == health_freq cadence), never be swallowed by the
+    windowed-verdict guard."""
+    mon = HealthMonitor(
+        "warn", thresholds=HealthThresholds(min_samples=3)
+    )
+    diverging = dict(_healthy_sample(), health_grad_norm=float("inf"))
+    findings = mon.ingest([(1, diverging)])  # 1 sample < min_samples=3
+    assert any("non-finite" in f for f in findings)
+    assert mon.alarms == 1
+
+
+def test_monitor_grad_norm_bar():
+    mon = HealthMonitor(
+        "warn", thresholds=HealthThresholds(grad_norm_max=10.0)
+    )
+    hot = dict(_healthy_sample(), health_grad_norm=50.0)
+    findings = mon.ingest([(1, hot), (2, hot)])
+    assert any("gradient norm" in f for f in findings)
+
+
+def test_monitor_rejects_unknown_policy():
+    with pytest.raises(ValueError):
+        HealthMonitor("explode")
+
+
+def test_health_abort_classified_as_code3_collectively():
+    """A RepresentationHealthError stored by a flush job exits the boundary
+    as ITSELF (failure code 3), not as the NaN policy's NonFiniteLossError
+    and not as TelemetryFlushError — the type the driver's policy switch
+    keys on."""
+    from simclr_pytorch_distributed_tpu.utils.telemetry import TelemetrySession
+
+    session = TelemetrySession(2, ("loss",), "sync")
+
+    def bad_job():
+        raise RepresentationHealthError(["collapse"], 7)
+
+    session.executor.submit(bad_job)
+    with pytest.raises(RepresentationHealthError):
+        session.check_failures_global(7)
+    session.close()
+
+
+# ------------------------------------------- driver-level collapse injection
+
+
+def test_collapse_injection_driver_aborts_with_typed_error(
+    tmp_path, monkeypatch
+):
+    """Feed the REAL supcon driver constant embeddings (two_view_forward
+    monkeypatched to a degenerate constant-feature forward): the windowed
+    detector must fire through the ring->flush->monitor path, leave a
+    health_alarm event in events.jsonl, and — under --health_policy abort —
+    exit run() with the typed RepresentationHealthError."""
+    import jax as _jax
+
+    from simclr_pytorch_distributed_tpu import config as config_lib
+    from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib
+    from simclr_pytorch_distributed_tpu.parallel import mesh as mesh_lib
+    from simclr_pytorch_distributed_tpu.train import supcon as supcon_driver
+
+    orig_synth = cifar_lib.synthetic_dataset
+    monkeypatch.setattr(
+        cifar_lib, "synthetic_dataset",
+        lambda n=2048, num_classes=10, seed=0, size=32: orig_synth(
+            n=200, num_classes=num_classes, seed=seed, size=SIZE
+        ),
+    )
+    monkeypatch.setattr(
+        supcon_driver, "create_mesh",
+        lambda devices=None, **kw: mesh_lib.create_mesh(
+            devices=_jax.devices()[:1] if devices is None else devices, **kw
+        ),
+    )
+
+    def constant_forward(model, params, batch_stats, images, *, train=True,
+                         with_features=False):
+        B = images.shape[0]
+        feats = jnp.ones((2 * B, 16), jnp.float32)
+        if with_features:
+            return (feats, feats), batch_stats
+        return feats, batch_stats
+
+    monkeypatch.setattr(supcon_step, "two_view_forward", constant_forward)
+
+    cfg = config_lib.SupConConfig(
+        model="resnet10", dataset="synthetic", batch_size=32, epochs=2,
+        learning_rate=0.05, cosine=True, save_freq=5, print_freq=2,
+        size=SIZE, workdir=str(tmp_path), seed=0, method="SimCLR",
+        telemetry="sync", data_placement="host",
+        health_freq=1, health_policy="abort",
+    )
+    cfg = config_lib.finalize_supcon(cfg)
+    with pytest.raises(RepresentationHealthError, match="collapse"):
+        supcon_driver.run(cfg)
+
+    events_path = os.path.join(cfg.save_folder, "events.jsonl")
+    events = [json.loads(x) for x in open(events_path).read().splitlines()]
+    alarms = [e for e in events if e["name"] == "health_alarm"]
+    assert alarms and alarms[0]["args"]["policy"] == "abort"
+    assert any("collapse" in f for f in alarms[0]["args"]["findings"])
+    # the boundary observed it as the collective code-3 exit
+    failures = [e for e in events if e["name"] == "flush_failure"]
+    assert failures and failures[0]["args"]["code"] == 3
+
+
+# --------------------------------- the zero-sync proof (acceptance criteria)
+
+
+def test_health_and_probe_add_no_device_transfers(tmp_path, monkeypatch):
+    """PR 7's mechanical recorder proof, re-run with health metrics AND the
+    online probe enabled: one real supcon epoch under device placement
+    counts EXACTLY the PR-4/PR-5 contract — 3 ring D2H (windows 2+2+1 of a
+    5-step epoch at print_freq 2) and 1 index upload — so the whole
+    training-health layer adds zero per-step transfers or syncs."""
+    import jax as _jax
+
+    from simclr_pytorch_distributed_tpu import config as config_lib
+    from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib
+    from simclr_pytorch_distributed_tpu.data import device_store
+    from simclr_pytorch_distributed_tpu.parallel import mesh as mesh_lib
+    from simclr_pytorch_distributed_tpu.train import supcon as supcon_driver
+    from simclr_pytorch_distributed_tpu.utils.telemetry import TelemetrySession
+
+    orig_synth = cifar_lib.synthetic_dataset
+    monkeypatch.setattr(
+        cifar_lib, "synthetic_dataset",
+        lambda n=2048, num_classes=10, seed=0, size=32: orig_synth(
+            n=200, num_classes=num_classes, seed=seed, size=SIZE
+        ),
+    )
+    monkeypatch.setattr(
+        supcon_driver, "create_mesh",
+        lambda devices=None, **kw: mesh_lib.create_mesh(
+            devices=_jax.devices()[:1] if devices is None else devices, **kw
+        ),
+    )
+
+    counts = {"ring": 0, "index": 0}
+
+    class CountingSession(TelemetrySession):
+        def __init__(self, window, keys, mode="async", **kw):
+            def counting_get(x):
+                counts["ring"] += 1
+                return _jax.device_get(x)
+
+            super().__init__(window, keys, mode, device_get=counting_get, **kw)
+
+    real_store = device_store.DeviceStore
+
+    class CountingStore(real_store):
+        def __init__(self, loader, mesh, **kw):
+            super().__init__(loader, mesh, **kw)
+            inner = self._index_put
+
+            def counting_put(idx):
+                counts["index"] += 1
+                return inner(idx)
+
+            self._index_put = counting_put
+
+    monkeypatch.setattr(supcon_driver, "TelemetrySession", CountingSession)
+    monkeypatch.setattr(device_store, "DeviceStore", CountingStore)
+
+    cfg = config_lib.SupConConfig(
+        model="resnet10", dataset="synthetic", batch_size=32, epochs=1,
+        learning_rate=0.05, cosine=True, save_freq=5, print_freq=2,
+        size=SIZE, workdir=str(tmp_path), seed=0, method="SimCLR",
+        telemetry="sync", data_placement="device", flight_recorder="on",
+        health_freq=1, online_probe="on", health_policy="warn",
+    )
+    cfg = config_lib.finalize_supcon(cfg)
+    supcon_driver.run(cfg)
+
+    # the mechanical bound: exactly the pre-health transfer contract
+    assert counts == {"ring": 3, "index": 1}
+
+    # ...and the health stream really flowed through those same transfers
+    events_path = os.path.join(cfg.save_folder, "events.jsonl")
+    events = [json.loads(x) for x in open(events_path).read().splitlines()]
+    windows = [e for e in events if e["name"] == "health_window"]
+    assert len(windows) == 3  # one summary per flushed window
+    last = windows[-1]["args"]
+    for k in HEALTH_METRIC_KEYS + ONLINE_PROBE_METRIC_KEYS:
+        assert k in last and math.isfinite(last[k]), k
+    assert not [e for e in events if e["name"] == "health_alarm"]
+
+
+# ------------------------------------------------- health_report + the gate
+
+
+def _window_event(step, **over):
+    args = dict(_healthy_sample(), step=step)
+    args.update(over)
+    return {"name": "health_window", "track": "health", "ph": "i",
+            "ts": 0.1 * step, "args": args}
+
+
+def test_health_report_builds_timeline_and_series():
+    import scripts.health_report as hr
+
+    events = [
+        {"name": "flush_boundary", "track": "main:flush", "ph": "X",
+         "ts": 0.0, "dur": 0.01},
+        _window_event(2, probe_top1=20.0),
+        _window_event(4, probe_top1=40.0, health_align=0.6),
+    ]
+    rep = hr.build_report(events)
+    assert rep["consistency"]["ok"]
+    assert rep["consistency"]["n_windows"] == 2
+    assert rep["series"]["health_align"]["last"] == 0.6
+    assert rep["probe"] == {
+        "first_top1": 20.0, "last_top1": 40.0, "best_top1": 40.0,
+        "windows": 2,
+    }
+    assert rep["findings"] == []
+
+
+def test_health_report_flags_alarms_and_collapse_signature():
+    import scripts.health_report as hr
+
+    events = [
+        _window_event(2),
+        {"name": "health_alarm", "track": "health", "ph": "i", "ts": 0.3,
+         "args": {"step": 4, "policy": "warn", "findings": ["collapse: x"]}},
+        _window_event(
+            4, health_eff_rank=1.0, health_align=1.0, health_neg_mean=1.0,
+        ),
+    ]
+    rep = hr.build_report(events)
+    assert rep["alarms"] and rep["alarms"][0]["step"] == 4
+    kinds = {f["kind"] for f in rep["findings"]}
+    assert "health_alarm" in kinds and "collapse_signature" in kinds
+
+
+def test_health_report_consistency_failures():
+    import scripts.health_report as hr
+
+    # empty stream
+    rep = hr.build_report([{"name": "x", "ph": "i", "ts": 0.0}])
+    assert not rep["consistency"]["ok"]
+    # torn stream: a window missing a required column
+    broken = _window_event(2)
+    del broken["args"]["health_unif"]
+    rep = hr.build_report([broken])
+    assert rep["consistency"]["missing_keys"] == ["health_unif"]
+    assert not rep["consistency"]["ok"]
+    # non-monotone steps
+    rep = hr.build_report([_window_event(4), _window_event(2)])
+    assert not rep["consistency"]["ok"]
+
+
+def test_health_report_gate_record_pass_fail_and_skip():
+    import scripts.health_report as hr
+    import scripts.ratchet as ratchet
+
+    events = [_window_event(2, probe_top1=15.0),
+              _window_event(4, probe_top1=55.0)]
+    report = hr.build_report(events)
+    artifact = hr.build_output("events.jsonl", report, "cpu")
+    rec = ratchet.health_report_gate_record(artifact)
+    assert rec["ok"] and rec["value"] == 55.0 and "skipped" not in rec
+
+    # probe below the CPU bar fails ON CPU...
+    low = hr.build_output(
+        "e", hr.build_report([_window_event(2, probe_top1=11.0)]), "cpu"
+    )
+    rec = ratchet.health_report_gate_record(low)
+    assert not rec["ok"] and "did not learn" in rec["error"]
+    # ...but pass-skips off-CPU with the reason on record
+    low_tpu = hr.build_output(
+        "e", hr.build_report([_window_event(2, probe_top1=11.0)]), "tpu"
+    )
+    rec = ratchet.health_report_gate_record(low_tpu)
+    assert rec["ok"] and "calibrated for the CPU smoke" in rec["skipped"]
+
+    # an alarm on the healthy smoke fails EVERYWHERE
+    alarm_events = [
+        _window_event(2),
+        {"name": "health_alarm", "track": "health", "ph": "i", "ts": 0.3,
+         "args": {"step": 2, "policy": "warn", "findings": ["collapse"]}},
+    ]
+    bad = hr.build_output(
+        "e", hr.build_report(alarm_events), "tpu"
+    )
+    rec = ratchet.health_report_gate_record(bad)
+    assert not rec["ok"] and "false positive" in rec["error"]
+
+    # a torn stream fails everywhere too
+    torn = _window_event(2)
+    del torn["args"]["health_eff_rank"]
+    rec = ratchet.health_report_gate_record(
+        hr.build_output("e", hr.build_report([torn]), "tpu")
+    )
+    assert not rec["ok"] and "inconsistent" in rec["error"]
+
+
+def test_health_report_cli_roundtrip(tmp_path):
+    import scripts.health_report as hr
+
+    events_path = tmp_path / "events.jsonl"
+    with open(events_path, "w") as f:
+        for e in (_window_event(2), _window_event(4)):
+            f.write(json.dumps(e) + "\n")
+    out = tmp_path / "report.json"
+    assert hr.main(["--events", str(events_path), "--json", str(out)]) == 0
+    artifact = json.loads(out.read_text())
+    assert artifact["schema"] == hr.SCHEMA
+    assert artifact["report"]["consistency"]["ok"]
+    assert artifact["device"] == jax.default_backend()

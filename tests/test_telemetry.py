@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from simclr_pytorch_distributed_tpu.ops.metrics import MetricBuffer, MetricRing
+from simclr_pytorch_distributed_tpu.ops.metrics import MetricRing
 from simclr_pytorch_distributed_tpu.utils import preempt
 from simclr_pytorch_distributed_tpu.utils.guard import NonFiniteLossError
 from simclr_pytorch_distributed_tpu.utils.telemetry import (
@@ -398,17 +398,6 @@ def test_tb_stream_equivalent_sync_vs_async():
         return stream
 
     assert run("sync") == run("async")
-
-
-def test_metric_buffer_batched_path_still_works():
-    """MetricBuffer keeps the compile-free batched path for non-ring
-    callers (eval-style: fetch once, exit the loop)."""
-    buf = MetricBuffer()
-    for i in range(3):
-        buf.append(i, _metrics(float(i)))
-    out = buf.flush()
-    assert [(i, m["loss"]) for i, m in out] == [(0, 0.0), (1, 1.0), (2, 2.0)]
-    assert buf.flush() == []
 
 
 # ---------------------------------------------------------------------------
