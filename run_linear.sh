@@ -4,13 +4,59 @@
 #
 # Exit-75 contract (docs/RESILIENCE.md): the probe keeps no full-state
 # checkpoints (epochs are seconds) — on preemption it persists the best
-# classifier so far and exits 75; this launcher relaunches up to
-# PREEMPT_RETRIES (default 3) times. --resume for the probe means exactly
+# classifier so far and exits 75; --resume for the probe means exactly
 # "retrain from scratch" (config.linear_parser documents the contract).
+# By default babysitting is DELEGATED to the fleet supervisor
+# (python -m simclr_pytorch_distributed_tpu.supervise); SUPERVISE=0 keeps
+# the legacy bounded shell loop. PREEMPT_RETRIES bounds relaunches in both.
 
 set -uo pipefail
 
 max_retries=${PREEMPT_RETRIES:-3}
+
+# the supervisor resolves resume dirs under the workdir; honor an override
+# in the passthrough args (both argparse spellings)
+workdir=./work_space
+prev=
+for a in "$@"; do
+  if [ "$prev" = "--workdir" ]; then workdir=$a; fi
+  case "$a" in --workdir=*) workdir=${a#--workdir=} ;; esac
+  prev=$a
+done
+
+if [ "${SUPERVISE:-1}" != "0" ]; then
+  # --all_run_dirs: the probe's run dirs are the classifier_* folders the
+  # pretrain-oriented default scan excludes — without it the supervisor's
+  # run-dir channel (stall dumps, recorder events) would be blind here.
+  # SUPERVISE_STALL_SECS / SUPERVISE_METRICS_PORT opt into liveness-kill
+  # exactly as in run_supcon.sh.
+  sup_args=()
+  trainer_args=()
+  if [ -n "${SUPERVISE_STALL_SECS:-}" ]; then
+    sup_args+=(--stall_secs "$SUPERVISE_STALL_SECS")
+    # the trainer's own watchdog is the dump channel of the stall verdict:
+    # without it (and without a metrics port) the supervisor would have no
+    # liveness source at all and the deadline would be a silent no-op
+    trainer_args+=(--watchdog_secs "$SUPERVISE_STALL_SECS")
+  fi
+  if [ -n "${SUPERVISE_METRICS_PORT:-}" ]; then
+    sup_args+=(--metrics_port "$SUPERVISE_METRICS_PORT")
+    trainer_args+=(--metrics_port "$SUPERVISE_METRICS_PORT")
+  fi
+  exec python -m simclr_pytorch_distributed_tpu.supervise \
+    --workdir "$workdir" \
+    --max_restarts "$max_retries" \
+    --all_run_dirs \
+    ${sup_args[@]+"${sup_args[@]}"} \
+    -- \
+    python main_linear.py \
+      --learning_rate 5 \
+      --batch_size 256 \
+      "$@" \
+      ${trainer_args[@]+"${trainer_args[@]}"}
+fi
+
+# ------------------------------------------------------- legacy (SUPERVISE=0)
 attempt=0
 resume_args=()
 while true; do

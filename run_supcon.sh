@@ -4,11 +4,14 @@
 # --ngpu 2 keeps the reference's DDP gradient-scale for recipe parity.
 #
 # Exit-75 contract (docs/RESILIENCE.md): 75 means "preempted, state saved
-# cleanly, re-run with --resume <run_dir>". This launcher closes that loop —
-# up to PREEMPT_RETRIES (default 3) relaunches, resuming from the newest
-# pretrain run dir under the workdir (resolve_resume_path picks the complete
-# checkpoint with the most progress inside it). Any other exit code passes
-# through untouched.
+# cleanly, re-run with --resume <run_dir>". By default this launcher
+# DELEGATES babysitting to the fleet supervisor
+# (python -m simclr_pytorch_distributed_tpu.supervise), which closes the
+# loop for every failure class — preempt resume, crash backoff-retry,
+# liveness stall kill, elastic resize — with each decision recorded in
+# <workdir>/supervise/events.jsonl. SUPERVISE=0 falls back to the legacy
+# bounded shell loop (exit-75 only). PREEMPT_RETRIES bounds relaunches in
+# both modes.
 
 set -uo pipefail
 
@@ -24,6 +27,47 @@ for a in "$@"; do
   prev=$a
 done
 
+if [ "${SUPERVISE:-1}" != "0" ]; then
+  # the supervisor injects --resume itself (argparse last-wins over any
+  # user-supplied --resume, same as the legacy loop's ordering) and exits
+  # with the final child's code, so callers see what bash would have seen.
+  # Liveness-kill is OPT-IN (off, the supervisor observes only):
+  #   SUPERVISE_STALL_SECS=300   kill+resume when the boundary stalls that
+  #                              long (set well above the first compile)
+  #   SUPERVISE_METRICS_PORT=N   wire the trainer's /metrics sidecar AND
+  #                              the supervisor's scrape to port N
+  sup_args=()
+  trainer_args=()
+  if [ -n "${SUPERVISE_STALL_SECS:-}" ]; then
+    sup_args+=(--stall_secs "$SUPERVISE_STALL_SECS")
+    # the trainer's own watchdog is the dump channel of the stall verdict:
+    # without it (and without a metrics port) the supervisor would have no
+    # liveness source at all and the deadline would be a silent no-op
+    trainer_args+=(--watchdog_secs "$SUPERVISE_STALL_SECS")
+  fi
+  if [ -n "${SUPERVISE_METRICS_PORT:-}" ]; then
+    sup_args+=(--metrics_port "$SUPERVISE_METRICS_PORT")
+    trainer_args+=(--metrics_port "$SUPERVISE_METRICS_PORT")
+  fi
+  exec python -m simclr_pytorch_distributed_tpu.supervise \
+    --workdir "$workdir" \
+    --max_restarts "$max_retries" \
+    ${sup_args[@]+"${sup_args[@]}"} \
+    -- \
+    python main_supcon.py \
+      --syncBN \
+      --epochs 100 \
+      --batch_size 256 \
+      --learning_rate 0.5 \
+      --temp 0.5 \
+      --cosine \
+      --method SimCLR \
+      --ngpu 2 \
+      "$@" \
+      ${trainer_args[@]+"${trainer_args[@]}"}
+fi
+
+# ------------------------------------------------------- legacy (SUPERVISE=0)
 # NOTE: resume_args comes AFTER "$@" — argparse is last-wins, so on a retry
 # the freshly resolved run dir beats any stale --resume the user passed.
 attempt=0

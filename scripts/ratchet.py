@@ -141,6 +141,20 @@ CONFIGS = {
     # list.
     "health_report": dict(model="resnet10", epochs=1, bar=None,
                           kind="health_report", dataset="synthetic"),
+    # round 11: the supervisor scenario-matrix gate. Unlike the driver-run
+    # gates above it binds on the COMMITTED evidence artifact
+    # (docs/evidence/supervisor_r11.json, produced by
+    # scripts/supervisor_matrix.py driving the REAL supervisor through
+    # SIGKILL / stall / collapse / preempt-then-resize against the real
+    # pretrain loop): the pure supervisor_gate_record re-verifies that all
+    # four scenarios are present, each ended in its expected decision
+    # sequence, and the resize leg really resumed onto a different
+    # topology. Re-produce the artifact with the matrix script when the
+    # supervisor's decision surface changes; instant, so it rides the
+    # default list.
+    "supervisor_gate": dict(model=None, epochs=0, bar=None,
+                            kind="supervisor_gate", dataset=None,
+                            artifact="docs/evidence/supervisor_r11.json"),
 }
 
 # CPU-calibrated bar for the health_report smoke's online probe: best
@@ -368,6 +382,72 @@ def health_report_gate_record(artifact, probe_bar=None):
     return record
 
 
+# the four failure shapes the supervisor matrix must prove, with the
+# decision sequence each one must have produced (scripts/supervisor_matrix.py
+# scenario expectations, re-checked here so a hand-edited artifact cannot
+# pass) — docs/RESILIENCE.md supervisor section
+SUPERVISOR_SCENARIOS = {
+    "sigkill": ["backoff_restart", "done"],
+    "stall": ["backoff_restart", "done"],
+    "collapse": ["give_up"],
+    "preempt_resize": ["restart_resized", "done"],
+}
+
+
+def supervisor_gate_record(artifact):
+    """Gate decision for the supervisor scenario-matrix evidence (pure —
+    tested without running a scenario).
+
+    Binds everywhere, hardware-independently (the trace_report convention):
+    the claims are about decision sequences and recorded events, not
+    timings. Checks: every scenario of :data:`SUPERVISOR_SCENARIOS` is
+    present and ``ok`` with exactly its expected decision sequence; the
+    collapse leg exited with the typed health code 3 after an observed
+    ``health_alarm``; the stall leg saw both liveness verdicts (the
+    supervisor's own and the in-child watchdog's dump); and the resize leg
+    actually resumed onto a different topology (``resumed_resized`` —
+    the mesh-shape-agnostic restore proven end to end).
+    """
+    scenarios = artifact.get("scenarios", {})
+    record = {
+        "metric": "ratchet_supervisor_matrix",
+        "value": len(scenarios),
+        "scenarios": sorted(scenarios),
+    }
+
+    def fail(msg):
+        record["ok"] = False
+        record["error"] = msg
+        return record
+
+    for name, expected in SUPERVISOR_SCENARIOS.items():
+        rec = scenarios.get(name)
+        if rec is None:
+            return fail(f"scenario {name!r} missing from the matrix artifact")
+        if not rec.get("ok"):
+            return fail(f"scenario {name!r} not ok in the matrix artifact")
+        if rec.get("decisions") != expected:
+            return fail(
+                f"scenario {name!r} decisions {rec.get('decisions')} != "
+                f"expected {expected}"
+            )
+    if scenarios["collapse"].get("rc") != 3:
+        return fail("collapse scenario did not exit with the typed health code 3")
+    if not scenarios["collapse"].get("health_alarms_observed"):
+        return fail("collapse scenario recorded no observed health_alarm")
+    if not (scenarios["stall"].get("liveness_stalls")
+            and scenarios["stall"].get("watchdog_dumps_observed")):
+        return fail("stall scenario lacks liveness/watchdog evidence")
+    resize = scenarios["preempt_resize"]
+    if not resize.get("resumed_resized"):
+        return fail("resize scenario did not resume onto a new topology")
+    devices = resize.get("launch_devices") or []
+    if len(set(d for d in devices if d)) < 2:
+        return fail(f"resize scenario launch_devices {devices} never changed")
+    record["ok"] = True
+    return record
+
+
 class ConfigFailed(RuntimeError):
     """One gated config could not produce a number; the others must still run."""
 
@@ -544,6 +624,24 @@ def run_config(name, spec, epochs, bar, args):
         print(json.dumps(record), flush=True)
         return record
 
+    if kind == "supervisor_gate":
+        # binds on the COMMITTED scenario-matrix evidence artifact (see the
+        # CONFIGS note): no subprocess — the matrix itself is re-run with
+        # scripts/supervisor_matrix.py when the supervisor changes
+        path = os.path.join(REPO, spec["artifact"])
+        try:
+            with open(path) as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ConfigFailed(
+                f"no readable supervisor evidence at {path}: {e}"
+            ) from e
+        record = supervisor_gate_record(artifact)
+        record["bar"] = bar
+        record["artifact"] = spec["artifact"]
+        print(json.dumps(record), flush=True)
+        return record
+
     if kind == "ce":
         # the CE trainer end-to-end: train + validate in one driver
         # (protocol of docs/evidence/ce_30ep.log: rn50, lr 0.1 cosine, bf16)
@@ -645,6 +743,8 @@ def main():
                 metric = "ratchet_trace_report_attribution"
             elif spec["kind"] == "health_report":
                 metric = "ratchet_health_report"
+            elif spec["kind"] == "supervisor_gate":
+                metric = "ratchet_supervisor_matrix"
             elif spec["kind"] in ("resident_ab", "window_ab"):
                 metric = f"ratchet_{spec['kind']}_equivalence"
             else:

@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""The supervisor scenario matrix — the REAL supervisor babysitting the REAL
+driver through the four failure shapes, producing the committed evidence
+artifact ``docs/evidence/supervisor_r11.json`` that ``scripts/ratchet.py``'s
+``supervisor_gate`` verifies.
+
+Scenarios (each in its own workdir; the victim is
+``scripts/supervisor_victim.py`` — a 7-step/epoch synthetic pretrain with
+one-shot injectable faults):
+
+- ``sigkill``: SIGKILL lands mid-run (no grace, torn async save possible);
+  the supervisor must observe the signal death, restart with ``--resume``
+  (resolution picks the newest COMPLETE save), and the job must finish —
+  decisions ``backoff_restart`` then ``done``;
+- ``stall``: the victim's main thread wedges at a flush boundary (and
+  absorbs SIGTERM via the preempt flag, like a dead collective); the
+  supervisor must see liveness die — the scraped
+  ``train_last_boundary_age_seconds`` climbing past the deadline, plus the
+  in-child watchdog's stall dump in the run dir — kill through the grace
+  escalation, and resume;
+- ``collapse``: impossible health thresholds force a representation-health
+  abort (typed exit 3) under ``--health_policy abort``; the supervisor must
+  GIVE UP (collapse lives in the weights — docs/RESILIENCE.md precedence),
+  exiting with the child's code;
+- ``preempt_resize``: a ``resize_request`` file arrives mid-run; the
+  supervisor gracefully preempts (SIGTERM -> emergency save -> exit 75)
+  and relaunches ``--resume`` onto the new virtual-mesh device count —
+  the elastic-resume proof (mesh-shape-agnostic restore,
+  utils/checkpoint.py) driven end to end.
+
+Each scenario prints one JSON line and lands in the artifact with its
+decision sequence, exit code, and the supervisor events file it came from.
+
+Usage:
+    python scripts/supervisor_matrix.py --json docs/evidence/supervisor_r11.json
+    python scripts/supervisor_matrix.py --scenarios sigkill stall
+"""
+
+import argparse
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from simclr_pytorch_distributed_tpu.supervise import (  # noqa: E402
+    SuperviseConfig,
+    Supervisor,
+)
+from simclr_pytorch_distributed_tpu.supervise.launch import (  # noqa: E402
+    find_resume_dir,
+)
+
+VICTIM = os.path.join(REPO, "scripts", "supervisor_victim.py")
+WAIT_S = 600.0  # per-wait ceiling (cold sharded compiles on a slow host)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_for(predicate, what: str, timeout_s: float = WAIT_S):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        v = predicate()
+        if v:
+            return v
+        time.sleep(0.25)
+    raise RuntimeError(f"timeout waiting for {what}")
+
+
+def _run_supervisor(cfg: SuperviseConfig):
+    """Run the supervisor on a thread; returns (supervisor, join->rc)."""
+    sup = Supervisor(cfg)
+    box = {}
+
+    def target():
+        box["rc"] = sup.run()
+
+    t = threading.Thread(target=target, name="supervisor", daemon=True)
+    t.start()
+
+    def join(timeout_s: float = WAIT_S) -> int:
+        t.join(timeout_s)
+        if t.is_alive():
+            raise RuntimeError("supervisor did not finish")
+        return box["rc"]
+
+    return sup, join
+
+
+def _events(sup: Supervisor):
+    return [json.loads(line) for line in open(sup.recorder._path)]
+
+
+def _record(name, sup, rc, expect_actions, detail=None):
+    actions = [d.action for d in sup.decisions]
+    events = _events(sup)
+    rec = {
+        "scenario": name,
+        "rc": rc,
+        "decisions": actions,
+        "expected_decisions": list(expect_actions),
+        "attempts": sum(1 for e in events if e["name"] == "launch"),
+        "events_file": os.path.relpath(sup.recorder._path, REPO),
+        "n_events": len(events),
+        "ok": actions == list(expect_actions),
+        **(detail or {}),
+    }
+    return rec, events
+
+
+def _victim_cmd(workdir, **kw):
+    cmd = [sys.executable, VICTIM, "--workdir", workdir]
+    for k, v in kw.items():
+        cmd += [f"--{k}", str(v)]
+    return cmd
+
+
+def _wait_for_checkpoint(workdir, name="ckpt_epoch_1"):
+    def check():
+        run_dir = find_resume_dir(workdir)
+        if run_dir and os.path.exists(os.path.join(run_dir, name, "meta.json")):
+            return run_dir
+        return None
+
+    return _wait_for(check, f"{name} in {workdir}")
+
+
+def scenario_sigkill(base):
+    # ckpt_epoch_1's async meta stamps at epoch 2's save drain, so the kill
+    # lands around epoch 3 of 4 — strictly mid-run, with a complete save on
+    # disk for the resume (the fault-harness kill9 geometry)
+    wd = os.path.join(base, "sigkill")
+    cfg = SuperviseConfig(
+        command=_victim_cmd(wd, epochs=4, trial="k9", save_freq=1),
+        workdir=wd, max_restarts=3, backoff_base_s=0.2, poll_s=0.25,
+    )
+    sup, join = _run_supervisor(cfg)
+    _wait_for_checkpoint(wd)
+    pid = sup.child.pid
+    os.kill(pid, signal.SIGKILL)
+    rc = join()
+    rec, _ = _record(
+        "sigkill", sup, rc, ["backoff_restart", "done"],
+        detail={"killed_pid": pid},
+    )
+    rec["ok"] = rec["ok"] and rc == 0
+    return rec
+
+
+def scenario_stall(base):
+    wd = os.path.join(base, "stall")
+    os.makedirs(wd, exist_ok=True)
+    port = _free_port()
+    cfg = SuperviseConfig(
+        # 7 requested_global calls per complete epoch (6 mid-epoch
+        # boundaries + the epoch edge): fault_step=16 wedges the main
+        # thread at epoch 3 boundary 2 — AFTER ckpt_epoch_1's meta stamped
+        # (epoch 2's save drain), so the post-kill resume has a complete
+        # save to resolve. watchdog_secs must exceed the child's STARTUP
+        # (jax import + first-step trace) — the watchdog arms at
+        # construction, and a pre-first-boundary false dump would be read
+        # as a stall verdict (the supervisor kills on the child's own dump
+        # by design); 15s clears a warm-cache startup severalfold while the
+        # real stall, which never beats again, still trips it
+        command=_victim_cmd(
+            wd, epochs=3, trial="stall", save_freq=1, fault="stall",
+            fault_step=16, fault_marker=os.path.join(wd, "stall.marker"),
+            metrics_port=port, watchdog_secs=15,
+        ),
+        workdir=wd, max_restarts=3, backoff_base_s=0.2, poll_s=0.25,
+        stall_secs=25.0, grace_secs=3.0, metrics_port=port,
+    )
+    sup, join = _run_supervisor(cfg)
+    rc = join()
+    rec, events = _record("stall", sup, rc, ["backoff_restart", "done"])
+    stall_events = [e for e in events if e["name"] == "liveness_stall"]
+    dump_events = [e for e in events if e["name"] == "stall_dump_observed"]
+    rec["liveness_stalls"] = len(stall_events)
+    rec["watchdog_dumps_observed"] = len(dump_events)
+    # the decision must have come from a LIVENESS verdict, and the
+    # in-child watchdog's artifact must have been surfaced too
+    rec["ok"] = bool(rec["ok"] and rc == 0 and stall_events and dump_events)
+    return rec
+
+
+def scenario_collapse(base):
+    wd = os.path.join(base, "collapse")
+    cfg = SuperviseConfig(
+        command=_victim_cmd(
+            wd, epochs=1, trial="collapse", fault="collapse",
+            health_freq=2, health_policy="abort",
+        ),
+        workdir=wd, max_restarts=3, backoff_base_s=0.2, poll_s=0.25,
+    )
+    sup, join = _run_supervisor(cfg)
+    rc = join()
+    rec, events = _record("collapse", sup, rc, ["give_up"])
+    alarms = [
+        e for e in events
+        if e["name"] == "trainer_event"
+        and e.get("args", {}).get("event") == "health_alarm"
+    ]
+    rec["health_alarms_observed"] = len(alarms)
+    rec["ok"] = bool(rec["ok"] and rc == 3 and alarms)
+    return rec
+
+
+def scenario_preempt_resize(base, devices_before=8, devices_after=4):
+    wd = os.path.join(base, "preempt_resize")
+    # epochs=4: the resize request (written once ckpt_epoch_1's meta is
+    # stamped, i.e. ~epoch 3) catches the child strictly mid-run; the
+    # generous grace covers the SIGTERM -> flush-boundary -> emergency-save
+    # exit-75 sequence on a slow host
+    cfg = SuperviseConfig(
+        command=_victim_cmd(wd, epochs=4, trial="resize", save_freq=1),
+        workdir=wd, max_restarts=3, backoff_base_s=0.2, poll_s=0.25,
+        grace_secs=120.0, devices=devices_before,
+    )
+    sup, join = _run_supervisor(cfg)
+    _wait_for_checkpoint(wd)
+    with open(os.path.join(sup.supervise_dir, "resize_request"), "w") as f:
+        f.write(str(devices_after))
+    rc = join()
+    rec, events = _record(
+        "preempt_resize", sup, rc, ["restart_resized", "done"],
+        detail={"devices_before": devices_before,
+                "devices_after": devices_after},
+    )
+    launches = [e["args"] for e in events if e["name"] == "launch"]
+    rec["launch_devices"] = [la.get("devices") for la in launches]
+    resized = [la for la in launches if la.get("devices") == devices_after]
+    # the relaunch must land on the NEW topology AND resume the old run
+    rec["resumed_resized"] = bool(resized and resized[0].get("resume"))
+    rec["ok"] = bool(rec["ok"] and rc == 0 and rec["resumed_resized"])
+    return rec
+
+
+SCENARIOS = {
+    "sigkill": scenario_sigkill,
+    "stall": scenario_stall,
+    "collapse": scenario_collapse,
+    "preempt_resize": scenario_preempt_resize,
+}
+
+
+def run_matrix(base, names):
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.abspath(os.path.join(REPO, ".jax_cache")),
+    )
+    scenarios = {}
+    for name in names:
+        rec = SCENARIOS[name](base)
+        print(json.dumps(rec), flush=True)
+        scenarios[name] = rec
+    return {
+        "metric": "supervisor_matrix",
+        "victim": os.path.relpath(VICTIM, REPO),
+        "scenarios": scenarios,
+        "ok": all(r["ok"] for r in scenarios.values()),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir",
+                    default=os.path.join(REPO, "work_space", "supervisor_matrix"))
+    ap.add_argument("--json", default="")
+    ap.add_argument("--scenarios", nargs="+", default=list(SCENARIOS),
+                    choices=list(SCENARIOS))
+    args = ap.parse_args()
+    os.makedirs(args.workdir, exist_ok=True)
+    artifact = run_matrix(args.workdir, args.scenarios)
+    print(json.dumps({"metric": "supervisor_matrix", "ok": artifact["ok"]}))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(artifact, f, indent=1)
+    sys.exit(0 if artifact["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -331,8 +331,10 @@ def supcon_parser() -> argparse.ArgumentParser:
                    default=d.nan_guard, help="abort + checkpoint on NaN loss")
     p.add_argument("--nan_policy", type=str, default=d.nan_policy,
                    choices=["abort", "rollback"],
-                   help="on NaN loss: die after the crash save, or restore "
-                        "the epoch backup, halve the LR, and continue")
+                   help="on NaN loss: die after the crash save (typed exit "
+                        "code 1, docs/RESILIENCE.md — what the supervisor "
+                        "keys on), or restore the epoch backup, halve the "
+                        "LR, and continue")
     p.add_argument("--telemetry", type=str, default=d.telemetry,
                    choices=["async", "sync"],
                    help="metric flush: background thread (zero sync on the "
@@ -367,7 +369,9 @@ def supcon_parser() -> argparse.ArgumentParser:
                    choices=["warn", "abort"],
                    help="on a windowed collapse/divergence verdict: log + "
                         "flight-recorder event, or exit with the typed "
-                        "RepresentationHealthError (never rolled back)")
+                        "RepresentationHealthError (exit code 3 — the "
+                        "supervisor gives up rather than retrying, since "
+                        "collapse lives in the weights; never rolled back)")
     p.add_argument("--online_probe", type=str, default=d.online_probe,
                    choices=["on", "off"],
                    help="train a detached linear probe on stop_gradient "

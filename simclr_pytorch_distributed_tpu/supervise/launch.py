@@ -1,0 +1,174 @@
+"""Child-process mechanics: command construction, resume resolution,
+topology env, graceful termination.
+
+The resume scan (:func:`find_resume_dir`) is the logic ``run_supcon.sh``
+carried in awk — newest run dir under ``<workdir>/*_models/``, excluding the
+probe/CE ``classifier_*``/``ce_*`` folders by BASENAME (a workdir path
+containing ``ce_`` must not hide every candidate; tests/test_launchers.py
+pinned that bug) — now in one tested place both the launcher delegation and
+the supervisor share. ``--resume`` is APPENDED to the user's command:
+argparse is last-wins, so a freshly resolved run dir beats any stale
+``--resume`` the user passed (the same contract the shell loop had).
+
+Topology (:func:`topology_env`): "relaunch resized" needs a way to hand the
+child a different device count. On the virtual CPU mesh the harness proves
+elasticity on, that is ``XLA_FLAGS --xla_force_host_platform_device_count=N``
+(rewritten idempotently, preserving unrelated flags). On a real fleet a
+resize is a scheduler-level relaunch onto a different slice — the supervisor
+still makes the restart-resized DECISION and records it; the env hook is the
+single-host realization. Checkpoint restore being mesh-shape-agnostic
+(utils/checkpoint.py) is what makes the relaunch legal either way.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import signal
+import subprocess
+import time
+from typing import Dict, List, Optional, Sequence
+
+# probe/CE run dirs are never resume candidates for a pretrain relaunch
+EXCLUDED_RUN_PREFIXES = ("classifier_", "ce_")
+
+_XLA_DEVCOUNT_RE = re.compile(r"--xla_force_host_platform_device_count=\S+")
+
+
+def find_resume_dir(
+    workdir: str, exclude: tuple = EXCLUDED_RUN_PREFIXES,
+    require_checkpoint: bool = False,
+) -> Optional[str]:
+    """Newest run dir under ``<workdir>/*_models/`` whose basename is not in
+    ``exclude``; None when there is nothing to resume from (first launch, or
+    the child died before creating its run dir).
+
+    The default exclusion targets a PRETRAIN job (probe/CE folders are
+    never its resume candidates). A supervisor babysitting the probe or CE
+    trainer passes ``exclude=()`` (the ``--all_run_dirs`` CLI flag): their
+    run dirs ARE the ``classifier_*``/``ce_*`` ones, and excluding them
+    would blind the run-dir watch channel entirely.
+
+    ``require_checkpoint`` restricts candidates to run dirs holding at
+    least one COMPLETE checkpoint (a ``*/meta.json`` marker) — the
+    ``--resume`` injection mode. Without it, a child that died before its
+    first save leaves an empty newest dir, and resuming from it makes the
+    trainer's resolve_resume_path fail on every retry until the budget
+    burns (each failed attempt minting another empty decoy); with it, the
+    supervisor falls back to an older complete run or a scratch restart.
+    The WATCH channel keeps the unfiltered newest dir — the current run's
+    artifacts live there whether or not it has saved yet."""
+    candidates = []
+    for models in sorted(
+        d for d in (os.path.join(workdir, n) for n in (
+            os.listdir(workdir) if os.path.isdir(workdir) else []
+        )) if d.endswith("_models") and os.path.isdir(d)
+    ):
+        for name in os.listdir(models):
+            path = os.path.join(models, name)
+            if os.path.isdir(path) and not name.startswith(tuple(exclude)):
+                if require_checkpoint and not glob.glob(
+                    os.path.join(path, "*", "meta.json")
+                ):
+                    continue
+                candidates.append(path)
+    if not candidates:
+        return None
+    return max(candidates, key=os.path.getmtime)
+
+
+def topology_env(
+    devices: Optional[int], base_env: Optional[Dict[str, str]] = None
+) -> Dict[str, str]:
+    """The child env for a given virtual-mesh device count.
+
+    ``devices=None`` leaves the environment untouched (the supervisor does
+    not manage topology unless asked). Otherwise the
+    ``--xla_force_host_platform_device_count`` flag inside ``XLA_FLAGS`` is
+    replaced-or-appended, preserving every other flag — the harness and the
+    tests' conftest both ride XLA_FLAGS, and clobbering it would silently
+    change unrelated behavior.
+    """
+    env = dict(os.environ if base_env is None else base_env)
+    if devices is None:
+        return env
+    flag = f"--xla_force_host_platform_device_count={int(devices)}"
+    flags = env.get("XLA_FLAGS", "")
+    if _XLA_DEVCOUNT_RE.search(flags):
+        flags = _XLA_DEVCOUNT_RE.sub(flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    env["XLA_FLAGS"] = flags
+    return env
+
+
+def build_command(
+    command: Sequence[str], resume_dir: Optional[str]
+) -> List[str]:
+    """The user's command, with ``--resume <dir>`` appended on relaunches
+    (last-wins over any user-supplied --resume; see module docstring)."""
+    cmd = list(command)
+    if resume_dir:
+        cmd += ["--resume", resume_dir]
+    return cmd
+
+
+class Child:
+    """One supervised attempt: a Popen plus the bookkeeping the supervisor
+    needs (which topology it runs, when it started).
+
+    stdout/stderr pass through to the supervisor's own (the trainer's log
+    lines stay visible exactly as under the shell launcher); the recorder —
+    not a pipe — is the supervisor's structured view of the child.
+    """
+
+    def __init__(
+        self,
+        command: Sequence[str],
+        resume_dir: Optional[str] = None,
+        devices: Optional[int] = None,
+        cwd: Optional[str] = None,
+    ):
+        self.command = build_command(command, resume_dir)
+        self.devices = devices
+        self.resume_dir = resume_dir
+        self.proc = subprocess.Popen(
+            self.command, env=topology_env(devices), cwd=cwd
+        )
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        return self.proc.wait(timeout=timeout)
+
+    def terminate_gracefully(
+        self, grace_s: float, sleep=time.sleep, poll_s: float = 0.1,
+        clock=time.monotonic,
+    ) -> int:
+        """SIGTERM, give the preemption machinery its grace window (the
+        emergency checkpoint + exit 75 path), then SIGKILL. Returns the
+        child's returncode. ``sleep``/``clock`` are injected TOGETHER (the
+        Supervisor passes its own pair) — a fake sleep against the real
+        clock would busy-spin the poll loop for the whole grace window."""
+        if self.proc.poll() is not None:
+            return self.proc.returncode
+        try:
+            self.proc.send_signal(signal.SIGTERM)
+        except OSError:  # exited between poll and signal
+            return self.proc.wait()
+        deadline = clock() + grace_s
+        while clock() < deadline:
+            if self.proc.poll() is not None:
+                return self.proc.returncode
+            sleep(poll_s)
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+        return self.proc.wait()

@@ -1,0 +1,157 @@
+"""Signal collection for the supervisor — scraping and run-dir watching.
+
+Three channels, all host-side, all read-only against the trainer:
+
+- :class:`MetricsScraper` — HTTP GET against the trainer's ``--metrics_port``
+  sidecar (utils/prom.py) with :func:`parse_prometheus_text`, the inverse of
+  ``render_prometheus`` for the unlabeled gauge lines the sidecar emits.
+  ``train_last_boundary_age_seconds`` is THE liveness signal; the scraper
+  never raises (a dead sidecar is itself an observation, returned as None).
+- :class:`RunDirWatcher` — incremental polling of the trainer's run dir for
+  the artifacts the observability layer drops: stall-watchdog dumps
+  (``stall_dump_N.txt``), ``health_alarm`` / ``nan_rollback`` /
+  ``preempt_exit`` events appended to the recorder's ``events*.jsonl``
+  (tail-read from a remembered offset — the file is append-only by
+  construction), and newly COMPLETE checkpoints (``*/meta.json``). Each
+  ``poll()`` returns only what is NEW since the last, so the supervisor's
+  own recorder logs each artifact exactly once.
+- exit codes arrive through ``subprocess`` and are classified by
+  :mod:`supervise.policy` — nothing to collect here.
+
+Nothing in this module (or anywhere in supervise/) ever initializes the jax
+backend — no ``jax.devices()``, no jit, no arrays: the supervisor is a
+host-only process that must never touch the accelerator its child needs.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+# recorder event names the watcher surfaces to the supervisor (the trainer
+# emits them on its side: utils/guard.py HealthMonitor, train/*.py)
+WATCHED_EVENTS = ("health_alarm", "nan_rollback", "preempt_exit", "stall_detected")
+
+
+def parse_prometheus_text(text: str) -> Dict[str, float]:
+    """Unlabeled ``name value`` lines -> dict; labeled/histogram series and
+    comment lines are skipped (the trainer sidecar emits only plain gauges;
+    tolerating the rest keeps the parser usable against the serve server's
+    richer exposition too)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "{" in line:
+            continue
+        parts = line.split()
+        if len(parts) != 2:
+            continue
+        try:
+            out[parts[0]] = float(parts[1])
+        except ValueError:
+            continue
+    return out
+
+
+class MetricsScraper:
+    """GET /metrics against the trainer sidecar; ``scrape()`` returns the
+    gauge dict or None (connection refused, timeout, bad body — a dead or
+    not-yet-up sidecar is an observation, not an error). ``opener`` is
+    injectable for the no-network unit tests."""
+
+    def __init__(
+        self, port: int, host: str = "127.0.0.1", timeout_s: float = 2.0,
+        opener=None,
+    ):
+        self.url = f"http://{host}:{port}/metrics"
+        self.timeout_s = timeout_s
+        self._opener = opener if opener is not None else self._http_get
+
+    def _http_get(self) -> str:
+        with urllib.request.urlopen(self.url, timeout=self.timeout_s) as r:
+            return r.read().decode()
+
+    def scrape(self) -> Optional[Dict[str, float]]:
+        try:
+            return parse_prometheus_text(self._opener())
+        except (OSError, urllib.error.URLError, ValueError):
+            return None
+
+
+class RunDirWatcher:
+    """Incremental view of one trainer run dir.
+
+    ``poll()`` returns ``(stall_dumps, events, checkpoints)`` — only items
+    NEW since the previous poll:
+
+    - ``stall_dumps``: paths of fresh ``stall_dump_N.txt`` files (the
+      watchdog's artifact — its presence is a liveness verdict from INSIDE
+      the process, complementing the scraper's outside view);
+    - ``events``: recorder records from every ``events*.jsonl`` in the dir
+      whose ``name`` is in :data:`WATCHED_EVENTS` (per-session ``_rK`` and
+      per-process ``_pN`` suffixes included — resumes open new files);
+    - ``checkpoints``: checkpoint dir names whose ``meta.json`` appeared
+      (progress evidence: a supervisor post-mortem shows what was SAVED
+      between decisions, not just what failed).
+
+    The run dir may not exist yet (the child creates it after config
+    finalization) — polls before that return empty results.
+    """
+
+    def __init__(self, run_dir: str):
+        self.run_dir = run_dir
+        # path -> mtime: a RELAUNCHED trainer restarts its watchdog counter
+        # at 1 and overwrites stall_dump_1.txt in the (reused) run dir, so
+        # path identity alone would hide every stall after the first — a
+        # changed mtime makes an overwritten dump new again
+        self._seen_dumps: Dict[str, float] = {}
+        self._offsets: Dict[str, int] = {}   # events file -> bytes consumed
+        self._seen_ckpts: set = set()
+
+    def _new_events(self) -> List[dict]:
+        events: List[dict] = []
+        for path in sorted(glob.glob(os.path.join(self.run_dir, "events*.jsonl"))):
+            offset = self._offsets.get(path, 0)
+            try:
+                with open(path) as f:
+                    f.seek(offset)
+                    chunk = f.read()
+            except OSError:
+                continue
+            # only consume COMPLETE lines: the trainer appends+flushes per
+            # record, but a poll can still race the write mid-line
+            consumed = chunk.rfind("\n") + 1
+            self._offsets[path] = offset + consumed
+            for line in chunk[:consumed].splitlines():
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("name") in WATCHED_EVENTS:
+                    rec["_file"] = os.path.basename(path)
+                    events.append(rec)
+        return events
+
+    def poll(self) -> Tuple[List[str], List[dict], List[str]]:
+        if not os.path.isdir(self.run_dir):
+            return [], [], []
+        dumps = []
+        for p in sorted(glob.glob(os.path.join(self.run_dir, "stall_dump_*.txt"))):
+            try:
+                mtime = os.path.getmtime(p)
+            except OSError:
+                continue
+            if self._seen_dumps.get(p) != mtime:
+                self._seen_dumps[p] = mtime
+                dumps.append(p)
+        ckpts = []
+        for meta in sorted(glob.glob(os.path.join(self.run_dir, "*", "meta.json"))):
+            name = os.path.basename(os.path.dirname(meta))
+            if name not in self._seen_ckpts:
+                self._seen_ckpts.add(name)
+                ckpts.append(name)
+        return dumps, self._new_events(), ckpts

@@ -1,0 +1,222 @@
+"""The supervisor's decision policy — pure, explicit, unit-testable.
+
+Everything the supervisor DOES flows through :meth:`DecisionPolicy.decide`:
+one ``ExitObservation`` (how the child left, what the channels saw on the
+way) in, one ``Decision`` out. The method performs no I/O and reads no
+clocks, so tests/test_supervise.py enumerates the whole decision table
+without launching a process — the same discipline as ``guard.FailurePolicy``
+and the ratchet's pure ``*_gate_record`` functions.
+
+The classification input is the typed exit-code surface (utils/guard.py,
+docs/RESILIENCE.md):
+
+==========================  =============================================
+child exit                  decision
+==========================  =============================================
+0                           DONE
+75 (preempt, state saved)   RESTART with ``--resume`` — immediately, no
+                            backoff (the exit was clean by contract); if a
+                            resize target is pending, RESTART_RESIZED onto
+                            it instead (mesh-shape-agnostic restore makes
+                            that legal, utils/checkpoint.py). Does NOT
+                            apply when the 75 was forced by the
+                            supervisor's own stall kill — that stays a
+                            backoff restart (see the stall row)
+3 (health abort)            GIVE_UP — collapse lives in the weights, so a
+                            relaunch from the crash save just re-detects
+                            it (the RESILIENCE.md precedence note); a
+                            human changes the recipe
+1 (NaN) / 2 (flush)         BACKOFF then restart with ``--resume`` — NaN
+                            may be a transient (bad host, ECC hiccup) and
+                            the in-driver ``--nan_policy rollback`` is the
+                            principled self-heal; flush failures are
+                            I/O-flavored and often clear
+signal death (rc < 0)       BACKOFF then restart with ``--resume`` —
+                            kill -9 / OOM left no grace, resume resolution
+                            picks the newest COMPLETE save
+supervisor-observed stall   the supervisor killed the child itself
+                            (liveness age or a watchdog dump); BACKOFF
+                            then restart with ``--resume``
+anything else               BACKOFF then restart — bounded by the budget,
+                            so a permanent failure (bad flag, import
+                            error) burns at most ``max_restarts`` cheap
+                            attempts before GIVE_UP reports the real code
+==========================  =============================================
+
+Restart budget: ``max_restarts`` bounds TOTAL relaunches (the launcher
+loop's ``PREEMPT_RETRIES`` contract, now shared by every failure class).
+Backoff is exponential in CONSECUTIVE failures — a clean preemption resets
+the streak (the fleet is healthy, the scheduler is just busy) — capped at
+``backoff_max_s``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from simclr_pytorch_distributed_tpu.utils import guard, preempt
+
+# Decision.action values (strings, not an enum: they go straight into
+# recorder events and the evidence artifact as JSON)
+DONE = "done"
+RESTART = "restart_resume"
+RESTART_RESIZED = "restart_resized"
+BACKOFF_RESTART = "backoff_restart"
+GIVE_UP = "give_up"
+# emitted by the SUPERVISOR loop (not decide()): the supervisor itself was
+# SIGTERM/SIGINT'd and relayed the signal to the child instead of relaunching
+SHUTDOWN = "shutdown"
+
+
+@dataclasses.dataclass(frozen=True)
+class ExitObservation:
+    """One child exit, as the supervisor saw it.
+
+    ``returncode`` follows the subprocess convention (negative = died to
+    that signal). ``stalled`` means the SUPERVISOR killed the child after
+    a liveness verdict (boundary age over the deadline, or a watchdog
+    stall dump appeared) — the returncode is then just our own SIGKILL.
+    ``stall_dumps``/``health_alarms`` count artifacts observed during the
+    attempt (forensics context for the decision event; a health ALARM
+    under ``--health_policy warn`` does not by itself end a run — only
+    the exit code 3 of an ``abort`` policy does).
+    """
+
+    returncode: int
+    stalled: bool = False
+    stall_dumps: int = 0
+    health_alarms: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """What the supervisor does next. ``delay_s`` is slept before the
+    relaunch; ``devices`` is the new topology for RESTART_RESIZED (None
+    everywhere else); ``reason`` is the human- and JSON-facing line."""
+
+    action: str
+    reason: str
+    delay_s: float = 0.0
+    devices: Optional[int] = None
+
+
+class DecisionPolicy:
+    """Decision state across one supervised run: the restart budget, the
+    consecutive-failure streak the backoff grows on, and the pending
+    resize target (set by the supervisor when a resize request arrives,
+    consumed by the first restartable exit that follows)."""
+
+    def __init__(
+        self,
+        max_restarts: int = 3,
+        backoff_base_s: float = 1.0,
+        backoff_max_s: float = 60.0,
+    ):
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+        if backoff_base_s <= 0 or backoff_max_s < backoff_base_s:
+            raise ValueError(
+                f"need 0 < backoff_base_s <= backoff_max_s, got "
+                f"{backoff_base_s}/{backoff_max_s}"
+            )
+        self.max_restarts = max_restarts
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.restarts = 0          # relaunches performed so far
+        self.failures = 0          # consecutive non-clean exits (backoff input)
+        self.pending_resize: Optional[int] = None
+
+    # ---------------------------------------------------------------- helpers
+    def backoff_s(self) -> float:
+        """Exponential backoff for the CURRENT consecutive-failure streak:
+        base * 2^(failures-1), capped. ``failures`` is incremented before
+        this is read (a first failure waits the base)."""
+        exp = max(0, self.failures - 1)
+        return min(self.backoff_max_s, self.backoff_base_s * (2.0 ** exp))
+
+    def request_resize(self, devices: int) -> None:
+        if devices <= 0:
+            raise ValueError(f"resize target must be positive, got {devices}")
+        self.pending_resize = int(devices)
+
+    def _restart(self, action: str, reason: str, delay_s: float = 0.0) -> Decision:
+        """Book a restart; a pending resize upgrades any restartable
+        decision (the resize request was the OPERATOR'S, so it must not be
+        lost to an unlucky crash landing before the preempt exit)."""
+        self.restarts += 1
+        if self.pending_resize is not None:
+            devices, self.pending_resize = self.pending_resize, None
+            return Decision(
+                RESTART_RESIZED,
+                f"{reason}; resizing to {devices} device(s)",
+                delay_s=delay_s, devices=devices,
+            )
+        return Decision(action, reason, delay_s=delay_s)
+
+    # ----------------------------------------------------------------- decide
+    def decide(self, obs: ExitObservation) -> Decision:
+        rc = obs.returncode
+        if rc == 0:
+            return Decision(DONE, "child completed (exit 0)")
+        if rc == guard.EXIT_HEALTH:
+            # never retried: collapse lives in the weights (RESILIENCE.md
+            # precedence note) — a relaunch from the crash save re-detects
+            # it one window in; the budget is irrelevant
+            return Decision(
+                GIVE_UP,
+                "representation-health abort (exit 3): collapse lives in "
+                "the weights — change the recipe, do not relaunch",
+            )
+        if self.restarts >= self.max_restarts:
+            return Decision(
+                GIVE_UP,
+                f"restart budget exhausted ({self.restarts}/"
+                f"{self.max_restarts}); last exit {rc}",
+            )
+        if rc == preempt.EXIT_PREEMPTED and not obs.stalled:
+            # clean by contract (state saved) — no backoff, and the
+            # failure streak resets: preemption is scheduling, not illness.
+            # NOT taken when the SUPERVISOR initiated the kill (obs.stalled):
+            # a responsive-enough child turns our stall SIGTERM into a tidy
+            # exit 75, but the condition that triggered the kill is still a
+            # failure — streak-resetting it would hammer the restart budget
+            # in a tight kill/relaunch loop and misattribute the
+            # supervisor's own kill as scheduler preemption in post-mortems
+            self.failures = 0
+            return self._restart(
+                RESTART, "preempted (exit 75, state saved): resume"
+            )
+        self.failures += 1
+        delay = self.backoff_s()
+        if obs.stalled:
+            reason = (
+                f"stalled (boundary liveness/watchdog; {obs.stall_dumps} "
+                f"dump(s)): killed, resume after {delay:g}s"
+            )
+            if rc == preempt.EXIT_PREEMPTED:
+                reason += " (child honored SIGTERM; state saved)"
+        elif rc == guard.EXIT_NONFINITE:
+            # exit 1 is also the interpreter's code for any unhandled
+            # crash — both shapes get the same bounded resume-and-retry
+            reason = (
+                f"non-finite loss abort or unhandled crash (exit 1): "
+                f"resume after {delay:g}s (for NaNs, consider "
+                f"--nan_policy rollback)"
+            )
+        elif rc == guard.EXIT_FLUSH:
+            # exit 2 is also argparse's usage-error code — a typo'd flag
+            # lands here too, so the reason names both readings
+            reason = (
+                f"telemetry flush failure or usage error (exit 2): resume "
+                f"after {delay:g}s (if it recurs instantly, check the "
+                f"command's flags)"
+            )
+        elif rc < 0:
+            reason = (
+                f"died to signal {-rc} (no grace): resume from the newest "
+                f"complete save after {delay:g}s"
+            )
+        else:
+            reason = f"unclassified exit {rc}: resume after {delay:g}s"
+        return self._restart(BACKOFF_RESTART, reason, delay_s=delay)

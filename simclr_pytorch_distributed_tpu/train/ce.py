@@ -54,6 +54,10 @@ from simclr_pytorch_distributed_tpu.train.linear import (
 )
 from simclr_pytorch_distributed_tpu.train.supcon import enable_compile_cache
 from simclr_pytorch_distributed_tpu.utils import preempt
+from simclr_pytorch_distributed_tpu.utils.guard import (
+    exit_code_for,
+    exit_with_code,
+)
 from simclr_pytorch_distributed_tpu.utils import tracing
 from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     resolve_resume_path,
@@ -221,7 +225,11 @@ def run(cfg: config_lib.LinearConfig):
         # restore goes through the TrainState facade state_for_save already
         # defines for the saver, then maps back onto CEState.
         resume_path = resolve_resume_path(cfg.resume)
-        restored, meta = restore_checkpoint(resume_path, state_for_save(state))
+        # mesh= -> elastic restore (orbax reshards onto this run's mesh;
+        # see the pretrain driver's note and utils/checkpoint.py)
+        restored, meta = restore_checkpoint(
+            resume_path, state_for_save(state), mesh=mesh
+        )
         state = CEState(
             step=restored.step, params=restored.params,
             batch_stats=restored.batch_stats, opt_state=restored.opt_state,
@@ -254,6 +262,9 @@ def run(cfg: config_lib.LinearConfig):
         return {"params": state.params, "batch_stats": state.batch_stats}
 
     preempt.install()
+    # explicit capture for the exit-code gauge (see the pretrain driver's
+    # note: sys.exc_info() in a finally also sees enclosing-frame handlers)
+    exit_exc = None
     try:
         for epoch in range(start_epoch, cfg.epochs + 1):
             t1 = time.time()
@@ -396,6 +407,9 @@ def run(cfg: config_lib.LinearConfig):
                     cleanup=(tb.close, telemetry.close),
                 )
 
+    except BaseException as e:
+        exit_exc = e
+        raise
     finally:
         preempt.uninstall()
         telemetry.close()
@@ -407,7 +421,7 @@ def run(cfg: config_lib.LinearConfig):
         # must land in the record, and the watchdog must still be watching
         # if that drain wedges); the post-loop wait below is then a no-op
         wait_for_saves()
-        obs.close()
+        obs.close(exit_code=exit_code_for(exit_exc))
     wait_for_saves()
     logging.info("best accuracy: %.2f, accuracy5: %.2f", best_acc, best_acc5)
     tb.close()
@@ -431,7 +445,9 @@ def state_for_save(state: CEState):
 
 def main(argv=None):
     cfg = config_lib.parse_linear(argv, ce=True)
-    run(cfg)
+    # typed exit codes (docs/RESILIENCE.md): NaN/flush aborts exit 1/2,
+    # preemption 75 via SystemExit — the supervisor's classification input
+    exit_with_code(lambda: run(cfg))
 
 
 if __name__ == "__main__":

@@ -68,6 +68,10 @@ from simclr_pytorch_distributed_tpu.train.state import make_optimizer
 from simclr_pytorch_distributed_tpu.train.supcon import enable_compile_cache
 from simclr_pytorch_distributed_tpu.train.supcon_step import epoch_position
 from simclr_pytorch_distributed_tpu.utils import preempt
+from simclr_pytorch_distributed_tpu.utils.guard import (
+    exit_code_for,
+    exit_with_code,
+)
 from simclr_pytorch_distributed_tpu.utils.checkpoint import (
     load_pretrained_variables,
     save_classifier,
@@ -363,6 +367,9 @@ def run(cfg: config_lib.LinearConfig):
         )
     preempt.install()
     preempted = False
+    # explicit capture for the exit-code gauge (see the pretrain driver's
+    # note: sys.exc_info() in a finally also sees enclosing-frame handlers)
+    exit_exc = None
     try:
         for epoch in range(1, cfg.epochs + 1):
             t1 = time.time()
@@ -477,6 +484,9 @@ def run(cfg: config_lib.LinearConfig):
             if val["top1"] > best_acc:
                 best_acc, best_acc5 = val["top1"], val["top5"]
                 best_params = jax.device_get(state.params)
+    except BaseException as e:
+        exit_exc = e
+        raise
     finally:
         preempt.uninstall()
         telemetry.close()
@@ -484,8 +494,14 @@ def run(cfg: config_lib.LinearConfig):
             store.close()  # stop the window prefetch worker on any exit
         tracer.close()
         # no async saves in the probe (save_classifier is blocking), so
-        # the observability teardown has nothing to wait for
-        obs.close()
+        # the observability teardown has nothing to wait for. The probe's
+        # preemption exit (SystemExit(75)) is raised AFTER this finally —
+        # unlike the pretrain driver's in-try raise — so the terminal
+        # exit-code gauge reads the `preempted` flag, not exc_info.
+        obs.close(exit_code=(
+            preempt.EXIT_PREEMPTED if preempted
+            else exit_code_for(exit_exc)
+        ))
 
     if best_params is not None:
         # beyond parity: persist the best probe head (the reference only
@@ -503,7 +519,9 @@ def run(cfg: config_lib.LinearConfig):
 
 def main(argv=None):
     cfg = config_lib.parse_linear(argv)
-    run(cfg)
+    # typed exit codes (docs/RESILIENCE.md): NaN/flush aborts exit 1/2,
+    # preemption 75 via SystemExit — the supervisor's classification input
+    exit_with_code(lambda: run(cfg))
 
 
 if __name__ == "__main__":

@@ -89,6 +89,8 @@ from simclr_pytorch_distributed_tpu.utils.guard import (
     FailurePolicy,
     NonFiniteLossError,
     check_finite_loss,
+    exit_code_for,
+    exit_with_code,
 )
 from simclr_pytorch_distributed_tpu.utils.logging_utils import TBLogger, setup_logging
 from simclr_pytorch_distributed_tpu.utils.profiling import StepTracer
@@ -576,7 +578,11 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
     meta = {}
     if cfg.resume:
         resume_path = resolve_resume_path(cfg.resume)
-        state, meta = restore_checkpoint(resume_path, state)
+        # mesh= makes the restore ELASTIC: orbax reshards onto THIS run's
+        # mesh on load, so a checkpoint saved under a different device
+        # count resumes here (the supervisor's restart-resized decision;
+        # _warn_mesh_change names the BN/ngpu consequences)
+        state, meta = restore_checkpoint(resume_path, state, mesh=mesh)
         # mid-epoch emergency save (utils/preempt.py): re-enter the epoch at
         # the first unconsumed batch of its deterministic permutation
         start_epoch, start_step = resume_position(meta, steps_per_epoch)
@@ -671,6 +677,11 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
     # collective (preempt.requested_global), so the emergency save below
     # sees all processes arrive (docs/RESILIENCE.md).
     preempt.install()
+    # captured explicitly for the terminal exit-code gauge: sys.exc_info()
+    # inside the finally would also see an exception being HANDLED in an
+    # enclosing frame (a caller's retry wrapper), misclassifying a clean
+    # run as that outer failure
+    exit_exc = None
     try:
         for epoch in range(start_epoch, cfg.epochs + 1):
             t1 = time.time()
@@ -789,6 +800,9 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
             config=config_lib.config_dict(cfg), epoch=cfg.epochs,
             extra_meta=policy_meta(),
         )
+    except BaseException as e:
+        exit_exc = e
+        raise
     finally:
         # On failure too: stop/flush an active profiler trace (it is most
         # valuable exactly when the epoch loop died), stop the telemetry
@@ -806,15 +820,19 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         wait_for_saves()
         # observability teardown LAST (after the final wait_for_saves so
         # the checkpoint_commit span lands in the record and the watchdog
-        # still watches a wedging drain) — the ordering lives on obs.close
-        obs.close()
+        # still watches a wedging drain) — the ordering lives on obs.close.
+        # The in-flight exception (if any) classifies the exit for the
+        # terminal gauge + run_exit event (utils/guard.py exit-code surface).
+        obs.close(exit_code=exit_code_for(exit_exc))
     sync_processes("supcon_run_end")
     return state
 
 
 def main(argv=None):
     cfg = config_lib.parse_supcon(argv)
-    run(cfg)
+    # typed exit codes (docs/RESILIENCE.md): health 3 > flush 2 > NaN 1,
+    # preemption 75 via SystemExit — the supervisor's classification input
+    exit_with_code(lambda: run(cfg))
 
 
 if __name__ == "__main__":

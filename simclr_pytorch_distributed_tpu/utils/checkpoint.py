@@ -57,8 +57,32 @@ MODEL_LAYOUT_VERSION = 2
 _PENDING: List[Tuple[List[ocp.StandardCheckpointer], str, dict]] = []
 
 
-def _abstract(tree):
-    return jax.tree.map(ocp.utils.to_shape_dtype_struct, tree)
+def _abstract(tree, mesh=None):
+    """Shape/dtype targets for an orbax restore.
+
+    With ``mesh`` given, every leaf is annotated with its sharding on the
+    CURRENT mesh (``parallel.mesh.state_sharding``'s layout: replicated
+    over 'data', channel-split over 'model' where it divides) — orbax then
+    RESHARDS on load, so a checkpoint saved under mesh shape A restores
+    directly onto mesh shape B (elastic resume, docs/RESILIENCE.md). A
+    sharded restore never round-trips the whole state through one device:
+    each device reads its own slice of the array file.
+
+    Without ``mesh`` (the default) leaves keep whatever sharding the
+    abstract tree's arrays carry — the single-host path, where the jitted
+    update's ``in_shardings`` does the placement on first dispatch.
+    """
+    if mesh is None:
+        return jax.tree.map(ocp.utils.to_shape_dtype_struct, tree)
+    from simclr_pytorch_distributed_tpu.parallel.mesh import state_sharding
+
+    shardings = state_sharding(mesh, tree)
+    return jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(
+            getattr(x, "shape", ()), getattr(x, "dtype", None), sharding=s
+        ),
+        tree, shardings,
+    )
 
 
 # One jitted whole-tree copy, shared by every consumer (restore re-owning
@@ -239,6 +263,13 @@ def save_checkpoint(
             "epoch": epoch, "step_in_epoch": int(step_in_epoch),
             "config": config or {},
             "model_layout": MODEL_LAYOUT_VERSION,
+            # the SAVING topology, for the elastic-resume diagnostics: a
+            # restore under a different shape is legal (orbax reshards on
+            # load) but worth naming, since per-device BN and an explicit
+            # --ngpu have shape-dependent training-math consequences
+            # (_warn_mesh_change, docs/RESILIENCE.md)
+            "devices": jax.device_count(),
+            "process_count": jax.process_count(),
         }
         if block:
             _write_meta(path, meta)
@@ -303,20 +334,33 @@ def resolve_resume_path(path: str) -> str:
     return max(candidates)[3]
 
 
-def restore_checkpoint(path: str, abstract_state) -> Tuple[Any, dict]:
+def restore_checkpoint(path: str, abstract_state, mesh=None) -> Tuple[Any, dict]:
     """Full-state resume. ``abstract_state`` is a freshly built TrainState with
-    the right structure (its values are only used as shape/dtype targets)."""
+    the right structure (its values are only used as shape/dtype targets).
+
+    MESH-SHAPE-AGNOSTIC: ``mesh`` (the run's current mesh) makes the restore
+    elastic — orbax reshards every leaf onto the current mesh's layout on
+    load (see ``_abstract``), so a checkpoint saved under N devices restores
+    under M with the optimizer/TrainState intact. The training-math
+    consequences of a shape change are the caller's contract, named loudly
+    at restore (``_warn_mesh_change``): batch composition is already
+    mesh-shape-independent (the EpochLoader's global permutation depends
+    only on ``base_seed + epoch``), ``--ngpu auto`` re-derives the gradient
+    divisor (with the effective-LR banner), and per-device BN statistics
+    (``--syncBN`` off) are the one documented divergence
+    (docs/RESILIENCE.md, elastic-resume section).
+    """
     path = os.path.abspath(path)
     model = _restore_tree(
         os.path.join(path, "model"),
         _abstract({"params": abstract_state.params,
-                   "batch_stats": abstract_state.batch_stats}),
+                   "batch_stats": abstract_state.batch_stats}, mesh),
     )
     train = _restore_tree(
         os.path.join(path, "train"),
         _abstract({"opt_state": abstract_state.opt_state,
                    "step": abstract_state.step,
-                   "record_norm_mean": abstract_state.record_norm_mean}),
+                   "record_norm_mean": abstract_state.record_norm_mean}, mesh),
     )
     state = abstract_state.replace(
         step=train["step"],
@@ -331,7 +375,8 @@ def restore_checkpoint(path: str, abstract_state) -> Tuple[Any, dict]:
             probe = _restore_tree(
                 probe_dir,
                 _abstract({"probe_params": abstract_state.probe_params,
-                           "probe_opt_state": abstract_state.probe_opt_state}),
+                           "probe_opt_state": abstract_state.probe_opt_state},
+                          mesh),
             )
             state = state.replace(
                 probe_params=probe["probe_params"],
@@ -368,7 +413,35 @@ def restore_checkpoint(path: str, abstract_state) -> Tuple[Any, dict]:
     with open(meta_path) as f:
         meta = json.load(f)
     _warn_layout_mismatch(path, meta)
+    _warn_mesh_change(path, meta)
     return state, meta
+
+
+def _warn_mesh_change(path: str, meta: dict) -> None:
+    """Name an elastic resume loudly: the restore itself is exact (orbax
+    reshards on load; batch composition depends only on seed+epoch), but
+    per-device BN statistics (``--syncBN`` off) and a fixed ``--ngpu``
+    divisor make the TRAINING MATH shape-dependent — the documented
+    divergence (docs/RESILIENCE.md elastic-resume section)."""
+    saved = meta.get("devices")
+    if saved is None:
+        return
+    try:
+        saved = int(saved)
+    except (TypeError, ValueError):
+        return
+    now = jax.device_count()
+    if saved != now:
+        import logging
+
+        logging.warning(
+            "elastic resume: checkpoint %s was saved under %d device(s), "
+            "restoring under %d — state resharded on load; batch "
+            "composition is unchanged (seed+epoch permutation), but "
+            "per-device BN statistics (--syncBN off) and a non-auto "
+            "--ngpu divisor do depend on the shape (docs/RESILIENCE.md)",
+            path, saved, now,
+        )
 
 
 def _warn_layout_mismatch(path: str, meta: dict) -> None:

@@ -50,6 +50,67 @@ from simclr_pytorch_distributed_tpu.utils import tracing
 ROLLBACK_LR_MULT = 0.5
 MAX_ROLLBACKS = 3
 
+# ----------------------------------------------------------- typed exit codes
+#
+# The drivers' process exit codes mirror the collective failure codes the
+# flush boundary allgathers (utils/telemetry.py _failure_code), so an external
+# operator — the supervisor (simclr_pytorch_distributed_tpu/supervise/), a
+# Prometheus alert on the terminal `train_exit_code` gauge, or a shell
+# launcher — can classify the last exit without parsing logs. Precedence when
+# several failures land in one window is decided by the collective code
+# exchange (health 3 > flush 2 > NaN 1); preemption keeps its own sysexits
+# code 75 (utils/preempt.EXIT_PREEMPTED). docs/RESILIENCE.md has the table.
+EXIT_NONFINITE = 1   # NonFiniteLossError under --nan_policy abort
+EXIT_FLUSH = 2       # TelemetryFlushError (non-NaN flush failure: TB IOError, D2H fault)
+EXIT_HEALTH = 3      # RepresentationHealthError under --health_policy abort
+
+
+def exit_code_for(exc: "BaseException | None") -> int:
+    """The typed exit code for an exception leaving a driver's run().
+
+    ``None`` (clean return) -> 0; ``SystemExit`` passes its own code through
+    (the preemption path raises ``SystemExit(75)``); the three typed failure
+    exceptions map to their collective failure codes; anything else is a
+    plain crash (1, the interpreter's default for an unhandled exception) so
+    launchers keying only on 75-vs-other keep working.
+    """
+    if exc is None:
+        return 0
+    if isinstance(exc, SystemExit):
+        code = exc.code
+        if code is None:
+            return 0
+        return code if isinstance(code, int) else 1
+    # local import not needed: TelemetryFlushError lives in utils/telemetry,
+    # which imports nothing from here at module scope
+    from simclr_pytorch_distributed_tpu.utils.telemetry import TelemetryFlushError
+
+    if isinstance(exc, RepresentationHealthError):
+        return EXIT_HEALTH
+    if isinstance(exc, TelemetryFlushError):
+        return EXIT_FLUSH
+    if isinstance(exc, NonFiniteLossError):
+        return EXIT_NONFINITE
+    return 1
+
+
+def exit_with_code(run_fn) -> None:
+    """The drivers' shared ``main()`` epilogue: run, convert the typed
+    failure exceptions into their exit codes (with the traceback logged —
+    the code replaces the interpreter's generic rc 1, not the diagnostics),
+    and let everything else (SystemExit 75, real bugs) propagate unchanged.
+    """
+    import logging
+
+    from simclr_pytorch_distributed_tpu.utils.telemetry import TelemetryFlushError
+
+    try:
+        run_fn()
+    except (RepresentationHealthError, TelemetryFlushError,
+            NonFiniteLossError) as e:
+        logging.exception("typed failure abort (exit code %d)", exit_code_for(e))
+        raise SystemExit(exit_code_for(e)) from e
+
 
 class NonFiniteLossError(RuntimeError):
     """Raised when the training loss goes NaN/Inf."""

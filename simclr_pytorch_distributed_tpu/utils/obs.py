@@ -83,11 +83,21 @@ class RunObservability:
         if self.gauges is not None:
             self.gauges.set(epoch=epoch)
 
-    def close(self) -> None:
+    def close(self, exit_code: int = None) -> None:
         """Teardown, last in the driver's ``finally`` (after the final
         ``wait_for_saves()``): stop the watchdog/sidecar threads, then
         uninstall and close the recorder — ``close()`` exports trace.json
-        and never raises."""
+        and never raises.
+
+        ``exit_code`` (the drivers pass ``guard.exit_code_for`` of the
+        in-flight exception) stamps the terminal ``train_exit_code`` gauge
+        and records a final ``run_exit`` event before the sidecar stops —
+        the supervisor's last scrape and the recorder's last line both
+        classify the exit without log parsing."""
+        if exit_code is not None:
+            if self.gauges is not None:
+                self.gauges.set_exit_code(exit_code)
+            tracing.event("run_exit", track="main:guard", code=int(exit_code))
         if self.watchdog is not None:
             self.watchdog.close()
         if self.sidecar is not None:

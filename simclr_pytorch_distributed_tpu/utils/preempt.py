@@ -26,9 +26,14 @@ import logging
 import signal
 from typing import Optional
 
-# EX_TEMPFAIL from sysexits.h: "temporary failure, retry later" — the launcher
+# EX_TEMPFAIL from sysexits.h: "temporary failure, retry later" — the operator
 # contract is: exit EXIT_PREEMPTED means state was saved cleanly, re-run the
 # same command with --resume <run_dir> (docs/RESILIENCE.md exit-code table).
+# The other typed exits live in utils/guard.py (EXIT_HEALTH 3 > EXIT_FLUSH 2 >
+# EXIT_NONFINITE 1); together they are the classification surface the fleet
+# supervisor (simclr_pytorch_distributed_tpu/supervise/) decides on — 75 is
+# the one code that relaunches WITHOUT backoff, and a resize is a legal
+# response to it (mesh-shape-agnostic restore, utils/checkpoint.py).
 EXIT_PREEMPTED = 75
 
 _SIGNALS = (signal.SIGTERM, signal.SIGINT)

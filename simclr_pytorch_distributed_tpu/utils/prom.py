@@ -175,14 +175,32 @@ class TrainerGauges:
     scrape time (pending checkpoint saves). ``last_boundary_age_seconds``
     is THE liveness signal: a scraper sees it climb monotonically exactly
     when the run is wedged.
+
+    Two supervisor-facing gauges (docs/RESILIENCE.md supervisor section):
+    ``start_time_seconds`` — the unix wall clock at construction, so a
+    scraper (the fleet supervisor, a Prometheus uptime alert) computes
+    process uptime without /proc access; and the TERMINAL ``exit_code``
+    gauge — stamped by :meth:`set_exit_code` on the way out of the driver
+    (utils/obs.RunObservability.close), absent until then, so the last
+    scrape before the sidecar dies classifies the exit (75 preempt,
+    3 health > 2 flush > 1 NaN — utils/guard.py exit-code surface) without
+    parsing logs.
     """
 
-    def __init__(self, clock: Callable[[], float] = time.monotonic):
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        wall_clock: Callable[[], float] = time.time,
+    ):
         self._clock = clock
         self._lock = threading.Lock()
-        self._values: Dict[str, float] = {}
+        self._values: Dict[str, float] = {"start_time_seconds": wall_clock()}
         self._lazy: Dict[str, Callable[[], float]] = {}
         self._last_boundary: Optional[float] = None
+
+    def set_exit_code(self, code: int) -> None:
+        """Stamp the terminal exit-code gauge (once, on the exit path)."""
+        self.set(exit_code=int(code))
 
     def beat(self, step: int) -> None:
         with self._lock:

@@ -5,7 +5,15 @@ resume — the only honest way to test the preemption machinery end-to-end
 (an in-process simulation cannot witness exit codes or kill -9 torn state).
 
 Usage: python fault_injection_child.py <workdir> <epochs> <resume> <trial> \
-           [save_freq] [data_placement]
+           [save_freq] [data_placement] [ngpu] [syncbn]
+
+``ngpu``/``syncbn`` exist for the elastic-resume mesh matrix (the parent
+also rewrites XLA_FLAGS' host-platform device count per child): pinning
+``--ngpu`` to a constant and ``--syncBN`` on removes the two documented
+shape-dependent terms (gradient divisor, per-device BN statistics), which
+is exactly the configuration under which an N-device -> M-device resume
+must reproduce the uninterrupted run (docs/RESILIENCE.md elastic-resume
+contract).
 
 Prints, on stdout (parent parses these):
 - ``SAVE_FOLDER <path>``  once config is finalized (before training);
@@ -57,6 +65,8 @@ save_freq = int(sys.argv[5]) if len(sys.argv) > 5 else 100
 # CPU); the parent pins 'host' to prove the preemption/resume contract on
 # the per-step H2D loop too — it is placement-independent (RESILIENCE.md)
 data_placement = sys.argv[6] if len(sys.argv) > 6 else "auto"
+ngpu = sys.argv[7] if len(sys.argv) > 7 else "2"
+sync_bn = (sys.argv[8] == "1") if len(sys.argv) > 8 else False
 
 from simclr_pytorch_distributed_tpu.train import supcon as supcon_driver  # noqa: E402
 
@@ -65,6 +75,7 @@ cfg = config_lib.SupConConfig(
     learning_rate=0.05, temp=0.5, cosine=True, save_freq=save_freq,
     print_freq=1, size=8, workdir=workdir, seed=0, method="SimCLR",
     trial=trial, resume=resume, data_placement=data_placement,
+    ngpu=config_lib.ngpu_arg(ngpu), syncBN=sync_bn,
 )
 cfg = config_lib.finalize_supcon(cfg)
 print(f"SAVE_FOLDER {cfg.save_folder}", flush=True)

@@ -41,16 +41,28 @@ STEPS_PER_EPOCH = 7  # the child's synthetic config: 224 train / batch 32
 
 class Child:
     """A driver subprocess whose stdout is streamed line-by-line so the test
-    can react (send a signal) at a chosen training step."""
+    can react (send a signal) at a chosen training step.
+
+    ``ndev`` pins the child's VIRTUAL mesh shape (the XLA host-platform
+    device count — rewritten through supervise.launch.topology_env, the
+    same env hook the supervisor's restart-resized relaunch uses), so the
+    kill-on-N / resume-on-M matrix runs each leg on a different topology.
+    ``ngpu``/``syncbn`` ride through to the child config (see its
+    docstring for why the matrix pins them)."""
 
     def __init__(self, workdir, epochs, resume="", trial="f", save_freq=100,
-                 data_placement="auto"):
-        env = os.environ.copy()
+                 data_placement="auto", ndev=None, ngpu="2", syncbn=False):
+        from simclr_pytorch_distributed_tpu.supervise.launch import (
+            topology_env,
+        )
+
+        env = topology_env(ndev, os.environ.copy())
         env["JAX_PLATFORMS"] = "cpu"
         env["JAX_COMPILATION_CACHE_DIR"] = os.path.abspath(CACHE)
         self.proc = subprocess.Popen(
             [sys.executable, CHILD, str(workdir), str(epochs), resume,
-             trial, str(save_freq), data_placement],
+             trial, str(save_freq), data_placement, str(ngpu),
+             "1" if syncbn else "0"],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=os.path.dirname(os.path.dirname(CHILD)) or ".",
         )
@@ -296,3 +308,124 @@ def test_nan_rollback_policy_completes_run(tmp_path, monkeypatch):
     with pytest.raises(NonFiniteLossError):
         supcon_driver.run(cfg2)
     assert os.path.isdir(os.path.join(cfg2.save_folder, "crash_epoch_1"))
+
+
+# ------------------------------------------------- elastic resume (mesh matrix)
+
+
+@pytest.mark.slow
+@pytest.mark.supervisor
+def test_kill_on_mesh_8_resume_on_mesh_4_matches_uninterrupted(tmp_path):
+    """The kill-on-N / resume-on-M leg of the elastic-resume contract
+    (docs/RESILIENCE.md): with the two shape-dependent terms pinned —
+    --syncBN on (global BN statistics) and a fixed --ngpu divisor — a run
+    preempted mid-epoch on an 8-device virtual mesh and resumed on a
+    4-device mesh must land on the params an UNINTERRUPTED 4-device run of
+    the same seed produces (batch composition is mesh-shape-independent by
+    construction: tests/test_data.py proves the permutation contract, this
+    proves it end-to-end through the real driver + orbax reshard-on-load).
+    The restore must also emit the loud elastic-resume note naming the
+    documented divergences (per-device BN, non-auto ngpu)."""
+    ref = Child(tmp_path / "ref4", epochs=2, trial="e4ref", ndev=4,
+                syncbn=True)
+    ref.wait_for_line("DONE step=")
+    assert ref.wait() == 0
+    ref_last = os.path.join(ref.save_folder(), "last")
+
+    victim = Child(tmp_path / "elastic", epochs=2, trial="e84", ndev=8,
+                   syncbn=True)
+    victim.wait_for_line("Train: [1][1/")
+    victim.proc.send_signal(signal.SIGTERM)
+    assert victim.wait() == preempt.EXIT_PREEMPTED
+    run_dir = victim.save_folder()
+
+    resumed = Child(tmp_path / "elastic", epochs=2, resume=run_dir,
+                    trial="e84", ndev=4, syncbn=True)
+    resumed.wait_for_line("DONE step=")
+    assert resumed.wait() == 0
+    # the loud divergence note: saved under 8 devices, restored under 4
+    note = resumed.grep("elastic resume")
+    assert note and "8 device(s), restoring under 4" in note[0], (
+        resumed.lines[:25])
+
+    a = _load_params(ref_last)
+    b = _load_params(os.path.join(resumed.save_folder(), "last"))
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_allclose(
+            a[k], b[k], rtol=1e-4, atol=1e-6,
+            err_msg=f"{k} diverged across the 8->4 device resume "
+                    f"(syncBN + fixed ngpu should be shape-independent)",
+        )
+
+
+# --------------------------------------------- the supervisor, real driver
+
+
+VICTIM = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                      "supervisor_victim.py")
+
+
+@pytest.mark.supervisor
+def test_supervisor_absorbs_nan_abort_and_resumes_real_driver(tmp_path, monkeypatch):
+    """The REAL supervisor babysitting the REAL driver through a typed
+    failure (tier-1 smoke; the full SIGKILL/stall/collapse/resize matrix is
+    scripts/supervisor_matrix.py + the slow tests): attempt 1 NaN-aborts
+    with exit code 1 after the crash save, the supervisor backoff-restarts
+    with --resume, attempt 2 (fault marker tripped) completes — and every
+    decision lands in the supervisor's events.jsonl."""
+    import json
+
+    from simclr_pytorch_distributed_tpu.supervise import policy
+    from simclr_pytorch_distributed_tpu.supervise.supervisor import (
+        SuperviseConfig,
+        Supervisor,
+    )
+
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", os.path.abspath(CACHE))
+    wd = str(tmp_path / "ws")
+    cfg = SuperviseConfig(
+        command=[sys.executable, os.path.abspath(VICTIM), "--workdir", wd,
+                 "--epochs", "2", "--trial", "nan", "--save_freq", "1",
+                 "--fault", "nan", "--fault_step", "2",
+                 "--fault_marker", str(tmp_path / "nan.marker")],
+        workdir=wd, max_restarts=3, backoff_base_s=0.1, poll_s=0.2,
+    )
+    sup = Supervisor(cfg)
+    rc = sup.run()
+    assert rc == 0
+    assert [d.action for d in sup.decisions] == [
+        policy.BACKOFF_RESTART, policy.DONE,
+    ]
+    with open(os.path.join(sup.supervise_dir, "events.jsonl")) as f:
+        events = [json.loads(line) for line in f]
+    launches = [e["args"] for e in events if e["name"] == "launch"]
+    assert len(launches) == 2
+    assert launches[1]["resume"], "the relaunch must carry --resume"
+    decisions = [e["args"] for e in events if e["name"] == "decision"]
+    assert decisions[0]["rc"] == 1  # the typed NaN exit, classified
+    # the crash save the resume resolved from was observed as evidence
+    assert any(e["name"] == "checkpoint_observed" for e in events)
+
+
+@pytest.mark.slow
+@pytest.mark.supervisor
+def test_supervisor_matrix_collapse_scenario_via_script(tmp_path, monkeypatch):
+    """Keep scripts/supervisor_matrix.py (the evidence producer) from
+    rotting: its fastest scenario, run exactly as the committed artifact
+    was produced, must pass and write a gate-accepted partial artifact."""
+    import json
+
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", os.path.abspath(CACHE))
+    out = tmp_path / "matrix.json"
+    proc = subprocess.run(
+        [sys.executable, "scripts/supervisor_matrix.py",
+         "--workdir", str(tmp_path / "ws"), "--scenarios", "collapse",
+         "--json", str(out)],
+        cwd=os.path.dirname(os.path.dirname(CHILD)) or ".",
+        capture_output=True, text=True, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    artifact = json.loads(out.read_text())
+    rec = artifact["scenarios"]["collapse"]
+    assert rec["ok"] and rec["rc"] == 3 and rec["decisions"] == ["give_up"]

@@ -221,3 +221,42 @@ def test_realign_schedule_count_moves_applied_lr_position():
     tx_const = make_optimizer(0.1, momentum=0.9, weight_decay=1e-4)
     st = tx_const.init(params)
     assert realign_schedule_count(st, 7) == st  # no schedule state: no-op
+
+
+def test_exit_code_for_typed_table():
+    """The typed exit-code surface (docs/RESILIENCE.md): codes mirror the
+    collective failure codes (health 3 > flush 2 > NaN 1), SystemExit
+    passes through (preempt 75), clean return is 0, and an arbitrary crash
+    degrades to the interpreter's 1."""
+    from simclr_pytorch_distributed_tpu.utils.guard import (
+        EXIT_FLUSH,
+        EXIT_HEALTH,
+        EXIT_NONFINITE,
+        NonFiniteLossError,
+        RepresentationHealthError,
+        exit_code_for,
+        exit_with_code,
+    )
+    from simclr_pytorch_distributed_tpu.utils.telemetry import (
+        TelemetryFlushError,
+    )
+
+    assert exit_code_for(None) == 0
+    assert exit_code_for(SystemExit(75)) == 75
+    assert exit_code_for(SystemExit()) == 0
+    assert exit_code_for(SystemExit("msg")) == 1
+    assert exit_code_for(NonFiniteLossError(float("nan"), 3)) == EXIT_NONFINITE == 1
+    assert exit_code_for(TelemetryFlushError("io")) == EXIT_FLUSH == 2
+    assert exit_code_for(RepresentationHealthError(["f"], 3)) == EXIT_HEALTH == 3
+    assert exit_code_for(ValueError("boom")) == 1
+
+    # the drivers' main() epilogue: typed failures become SystemExit with
+    # the right code; everything else propagates untouched
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit) as e:
+        exit_with_code(lambda: (_ for _ in ()).throw(
+            RepresentationHealthError(["collapse"], 1)))
+    assert e.value.code == 3
+    with _pytest.raises(ValueError):
+        exit_with_code(lambda: (_ for _ in ()).throw(ValueError("real bug")))

@@ -7,6 +7,15 @@ run the REAL launcher scripts against a stub ``python`` on PATH that logs
 its argv and scripts the exit codes, proving: bounded retries happen only on
 exit 75, ``--resume`` points at the newest pretrain run dir, and every other
 exit code passes through untouched.
+
+Two launcher paths share the stub-python pattern:
+
+- the DEFAULT path delegates babysitting to the supervisor CLI
+  (``python -m simclr_pytorch_distributed_tpu.supervise -- python
+  main_supcon.py ...``) — the stub sees the delegation argv, and the
+  launcher's exit code is the supervisor's;
+- ``SUPERVISE=0`` keeps the legacy bounded shell loop, whose behavior the
+  original tests below pin unchanged.
 """
 
 import os
@@ -36,8 +45,14 @@ exit "${{codes[$((count - 1))]}}"
     return log
 
 
-def run_launcher(script, args, bin_dir, tmp_path):
-    env = dict(os.environ, PATH=f"{bin_dir}:{os.environ['PATH']}")
+def run_launcher(script, args, bin_dir, tmp_path, supervise="0"):
+    """Legacy loop by default (``SUPERVISE=0``) — the original contract
+    tests below pin that path; pass ``supervise='1'`` for the delegation
+    path."""
+    env = dict(
+        os.environ, PATH=f"{bin_dir}:{os.environ['PATH']}",
+        SUPERVISE=supervise,
+    )
     return subprocess.run(
         ["bash", os.path.join(REPO, script), *args],
         env=env, cwd=tmp_path, capture_output=True, text=True, timeout=60,
@@ -140,3 +155,93 @@ def test_linear_retries_from_scratch_then_passes_through(tmp_path, bin_dir):
     assert "--resume" not in calls[0]
     assert "--resume preempted-retry" in calls[1]  # probe: retrain from scratch
     assert "--ckpt x" in calls[1]  # user args survive the relaunch
+
+
+# -------------------------------------------------- supervisor delegation
+
+
+def test_supcon_default_path_delegates_to_supervisor(tmp_path, bin_dir):
+    """SUPERVISE unset/1: one stub invocation carrying the supervisor
+    module, the launcher's workdir/retry budget as supervisor flags, and
+    the full trainer command after ``--``; the supervisor's exit code IS
+    the launcher's."""
+    workdir = tmp_path / "ws"
+    log = write_stub_python(bin_dir, tmp_path, exit_codes=[7])
+    proc = run_launcher(
+        "run_supcon.sh", ["--workdir", str(workdir)], bin_dir, tmp_path,
+        supervise="1",
+    )
+    assert proc.returncode == 7, proc.stderr
+    calls = log.read_text().splitlines()
+    assert len(calls) == 1  # retries are the SUPERVISOR'S job now
+    call = calls[0]
+    assert "-m simclr_pytorch_distributed_tpu.supervise" in call
+    assert f"--workdir {workdir}" in call
+    assert "--max_restarts 3" in call
+    # the trainer command rides after the separator, recipe flags intact
+    sep = call.index(" -- ")
+    assert "python main_supcon.py" in call[sep:]
+    assert "--method SimCLR" in call[sep:]
+    assert f"--workdir {workdir}" in call[sep:]  # user args pass through
+
+
+def test_supcon_supervisor_honors_preempt_retries_env(tmp_path, bin_dir):
+    log = write_stub_python(bin_dir, tmp_path, exit_codes=[0])
+    env_retries = dict(os.environ, PREEMPT_RETRIES="7")
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "run_supcon.sh")],
+        env=dict(env_retries, PATH=f"{bin_dir}:{os.environ['PATH']}",
+                 SUPERVISE="1"),
+        cwd=tmp_path, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "--max_restarts 7" in log.read_text()
+
+
+def test_linear_default_path_delegates_to_supervisor(tmp_path, bin_dir):
+    log = write_stub_python(bin_dir, tmp_path, exit_codes=[0])
+    proc = run_launcher(
+        "run_linear.sh", ["--ckpt", "x"], bin_dir, tmp_path, supervise="1",
+    )
+    assert proc.returncode == 0, proc.stderr
+    call = log.read_text().splitlines()[0]
+    assert "-m simclr_pytorch_distributed_tpu.supervise" in call
+    sep = call.index(" -- ")
+    # the probe's run dirs are classifier_* — the supervisor must be told
+    # not to exclude them, or its watch channel is blind
+    assert "--all_run_dirs" in call[:sep]
+    assert "python main_linear.py" in call[sep:]
+    assert "--ckpt x" in call[sep:]  # user args survive the delegation
+
+
+def test_supcon_supervisor_liveness_env_wiring(tmp_path, bin_dir):
+    """SUPERVISE_STALL_SECS / SUPERVISE_METRICS_PORT opt into liveness-kill:
+    the supervisor gets --stall_secs/--metrics_port and the TRAINER command
+    gets the matching --metrics_port (after user args: argparse last-wins),
+    so one env var wires both ends of the scrape to the same port."""
+    log = write_stub_python(bin_dir, tmp_path, exit_codes=[0])
+    env = dict(
+        os.environ, PATH=f"{bin_dir}:{os.environ['PATH']}", SUPERVISE="1",
+        SUPERVISE_STALL_SECS="300", SUPERVISE_METRICS_PORT="9100",
+    )
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "run_supcon.sh")],
+        env=env, cwd=tmp_path, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    call = log.read_text().splitlines()[0]
+    sep = call.index(" -- ")
+    assert "--stall_secs 300" in call[:sep]
+    assert "--metrics_port 9100" in call[:sep]
+    assert "--metrics_port 9100" in call[sep:]  # the trainer side too
+    # the trainer's watchdog is the stall verdict's dump channel: without
+    # it SUPERVISE_STALL_SECS alone would be a silent no-op
+    assert "--watchdog_secs 300" in call[sep:]
+    # unset -> observe-only: no liveness flags anywhere
+    (tmp_path / "b").mkdir()
+    log2 = write_stub_python(bin_dir, tmp_path / "b", exit_codes=[0])
+    proc2 = run_launcher("run_supcon.sh", [], bin_dir, tmp_path / "b",
+                         supervise="1")
+    assert proc2.returncode == 0
+    call2 = log2.read_text().splitlines()[0]
+    assert "--stall_secs" not in call2 and "--metrics_port" not in call2

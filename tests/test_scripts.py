@@ -841,3 +841,23 @@ def test_trace_report_gate_record():
     ]))
     r = ratchet.trace_report_gate_record(silent)
     assert not r["ok"] and "flush-boundary" in r["error"]
+
+
+def test_no_stale_pycache_for_deleted_modules():
+    """A __pycache__ .pyc whose source module no longer exists (e.g. the
+    once-stray serve/__pycache__/registry.cpython-310.pyc) advertises a
+    dead module name to grep/archaeology — untracked, so the git hygiene
+    test above can't see it. Bytecode for LIVE modules is fine."""
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    pkg = os.path.join(repo, "simclr_pytorch_distributed_tpu")
+    stale = []
+    for dirpath, _, files in os.walk(pkg):
+        if os.path.basename(dirpath) != "__pycache__":
+            continue
+        for f in files:
+            if not f.endswith(".pyc"):
+                continue
+            module = f.split(".")[0] + ".py"
+            if not os.path.exists(os.path.join(os.path.dirname(dirpath), module)):
+                stale.append(os.path.relpath(os.path.join(dirpath, f), repo))
+    assert not stale, f"stale bytecode for deleted modules: {stale}"

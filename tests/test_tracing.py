@@ -296,6 +296,23 @@ def test_trainer_gauges_liveness_age():
     assert "train_step 120" in g.prometheus_text()
 
 
+def test_trainer_gauges_supervisor_surface():
+    """The supervisor-facing gauges (docs/RESILIENCE.md): start_time_seconds
+    is stamped from the injectable WALL clock at construction (uptime
+    without /proc), and exit_code is a terminal gauge — absent until the
+    driver's exit path stamps it (RunObservability.close), then exposed so
+    the last scrape classifies the exit."""
+    g = prom.TrainerGauges(clock=FakeClock(), wall_clock=lambda: 1722.25)
+    out = g.collect()
+    assert out["start_time_seconds"] == 1722.25
+    assert "exit_code" not in out  # terminal: absent while running
+    g.set_exit_code(75)
+    assert g.collect()["exit_code"] == 75.0
+    text = g.prometheus_text()
+    assert "train_start_time_seconds 1722.25" in text
+    assert "train_exit_code 75" in text
+
+
 def test_metrics_sidecar_http_endpoint():
     g = prom.TrainerGauges(clock=FakeClock())
     g.beat(7)
