@@ -29,7 +29,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from simclr_pytorch_distributed_tpu.utils.guard import (  # noqa: E402
-    HealthThresholds,
+    thresholds_for_recipe,
 )
 
 SCHEMA = "health_report/v1"
@@ -41,13 +41,23 @@ REQUIRED_HEALTH_KEYS = (
     "health_grad_norm", "health_neg_max", "health_neg_mean", "health_unif",
 )
 
-# final-window collapse signature (report-only; the LIVE verdicts are the
-# HealthMonitor's — read off guard.HealthThresholds' defaults, not copied,
-# so the offline reader cannot drift from the live detector)
-_DEFAULTS = HealthThresholds()
-EFF_RANK_MIN = _DEFAULTS.eff_rank_min
-ALIGN_MAX = _DEFAULTS.align_max
-NEG_MEAN_MAX = _DEFAULTS.neg_mean_max
+# Final-window collapse signature (report-only; the LIVE verdicts are the
+# HealthMonitor's — read off guard.thresholds_for_recipe, not copied, so
+# the offline reader cannot drift from the live detector). RECIPE-AWARE:
+# the per-recipe bars (guard.RECIPE_HEALTH_THRESHOLDS — the negative-free
+# recipes run under a raised eff-rank bar) are resolved from the run's
+# recorded ``run_recipe`` event (train/supcon.py stamps it at startup) or
+# the --recipe override, so an offline reader reaches the SAME verdict the
+# live monitor would; pre-recipe streams resolve to the defaults.
+
+
+def recipe_from_events(events) -> "str | None":
+    """The run's recorded recipe (the driver's ``run_recipe`` guard event),
+    or ``None`` for pre-recipe / probe / CE streams."""
+    for e in events:
+        if e.get("name") == "run_recipe":
+            return e.get("args", {}).get("recipe")
+    return None
 
 # guard events that are findings in themselves (trace_report's convention)
 EVENT_FLAGS = {
@@ -69,11 +79,15 @@ def load_events(path):
     return events
 
 
-def build_report(events):
+def build_report(events, recipe=None):
     """The health report (pure — tests/test_health.py drives it on synthetic
-    event lists)."""
+    event lists). ``recipe`` overrides the recipe recorded in the stream;
+    the resolved name selects the per-recipe collapse-signature bars
+    (guard.thresholds_for_recipe — the live monitor's own table)."""
     if not events:
         raise ValueError("no events: recorder off or empty run?")
+    recipe = recipe if recipe is not None else recipe_from_events(events)
+    bars = thresholds_for_recipe(recipe)
     windows = [
         e.get("args", {}) for e in events
         if e.get("name") == "health_window" and e.get("track") == "health"
@@ -106,14 +120,16 @@ def build_report(events):
         findings.append({"kind": name, "flag": f"{EVENT_FLAGS[name]} (x{count})"})
     if timeline:
         last = timeline[-1]
-        if float(last.get("health_eff_rank", float("inf"))) < EFF_RANK_MIN:
+        if float(last.get("health_eff_rank", float("inf"))) < bars.eff_rank_min:
             findings.append({
                 "kind": "collapse_signature",
                 "flag": f"final-window effective rank "
-                        f"{last['health_eff_rank']:.3g} < {EFF_RANK_MIN:g}",
+                        f"{last['health_eff_rank']:.3g} < "
+                        f"{bars.eff_rank_min:g}"
+                        + (f" (recipe {recipe} bar)" if recipe else ""),
             })
-        if (float(last.get("health_align", 0.0)) > ALIGN_MAX
-                and float(last.get("health_neg_mean", 0.0)) > NEG_MEAN_MAX):
+        if (float(last.get("health_align", 0.0)) > bars.align_max
+                and float(last.get("health_neg_mean", 0.0)) > bars.neg_mean_max):
             findings.append({
                 "kind": "collapse_signature",
                 "flag": "final-window positives AND negatives ~1",
@@ -153,6 +169,13 @@ def build_report(events):
         "findings": findings,
         "consistency": consistency,
         "n_events": len(events),
+        # the verdict's provenance: which recipe's bars judged the stream
+        "recipe": recipe,
+        "thresholds": {
+            "eff_rank_min": bars.eff_rank_min,
+            "align_max": bars.align_max,
+            "neg_mean_max": bars.neg_mean_max,
+        },
     }
 
 
@@ -205,9 +228,26 @@ def main(argv=None):
                     help="a flight-recorder events.jsonl (run dir artifact)")
     ap.add_argument("--json", default="",
                     help="write the health-report artifact here")
+    ap.add_argument("--recipe", default=None,
+                    help="override the recorded recipe when selecting the "
+                        "per-recipe collapse-signature bars (default: the "
+                        "stream's run_recipe event)")
     args = ap.parse_args(argv)
+    if args.recipe is not None:
+        from simclr_pytorch_distributed_tpu.utils.guard import (
+            RECIPE_HEALTH_THRESHOLDS,
+        )
 
-    report = build_report(load_events(args.events))
+        # a typo'd override would silently judge the stream by the DEFAULT
+        # bars while stamping the bogus name as verdict provenance —
+        # exactly the live/offline drift the recipe-aware report prevents
+        if args.recipe not in RECIPE_HEALTH_THRESHOLDS:
+            ap.error(
+                f"--recipe {args.recipe!r} is not a known recipe "
+                f"(choose from {sorted(RECIPE_HEALTH_THRESHOLDS)})"
+            )
+
+    report = build_report(load_events(args.events), recipe=args.recipe)
     print(render_table(report))
     if args.json:
         import jax  # lazy: the report itself is pure json-over-json

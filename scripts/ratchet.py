@@ -155,6 +155,16 @@ CONFIGS = {
     "supervisor_gate": dict(model=None, epochs=0, bar=None,
                             kind="supervisor_gate", dataset=None,
                             artifact="docs/evidence/supervisor_r11.json"),
+    # round 12: the SSL-recipe gate (scripts/recipes_eval.py --smoke; the
+    # recipes/ subsystem). Binds EVERYWHERE on the supcon-refactor
+    # BIT-IDENTITY (recipe interface vs the pre-refactor inline update,
+    # host and device placement — hardware-independent, the resident_ab
+    # convention) and on zero collapse alarms per recipe; the per-recipe
+    # online-probe learning bars (RECIPE_PROBE_CPU_BARS) are CPU-calibrated
+    # and pass-skip elsewhere with the reason on record. Minutes, so it
+    # rides the default list.
+    "recipes": dict(model="resnet10", epochs=1, bar=None, kind="recipes",
+                    dataset="synthetic"),
 }
 
 # CPU-calibrated bar for the health_report smoke's online probe: best
@@ -164,6 +174,23 @@ CONFIGS = {
 # "the probe LEARNS, live, from inside the compiled update", not a precise
 # accuracy.
 HEALTH_PROBE_CPU_BAR = 20.0
+
+# CPU-calibrated online-probe bars for the recipes_eval smoke (chance 10%
+# on the 10-class synthetic color-mean set; one 28-step epoch at size 8,
+# seed 0). Calibration measured best-window top-1 of 46.8 (supcon), 46.9
+# (byol), 46.9 (simsiam), 47.1 (vicreg), 46.9 (simclr_queue) — the
+# round-12 smoke protocol; the committed full-config artifact
+# (docs/evidence/recipes_r12.json) sits at 45.4-50.6. Bars = beat-random
+# with a wide margin (the HEALTH_PROBE_CPU_BAR convention): the claim is
+# "every recipe LEARNS, live, through the same substrate", not a precise
+# accuracy.
+RECIPE_PROBE_CPU_BARS = {
+    "supcon": 20.0,
+    "byol": 20.0,
+    "simsiam": 20.0,
+    "vicreg": 20.0,
+    "simclr_queue": 20.0,
+}
 
 
 def bench_metric_name(spec):
@@ -379,6 +406,80 @@ def health_report_gate_record(artifact, probe_bar=None):
             f"online probe best top-1 {probe['best_top1']:.2f} < "
             f"{probe_bar:g}: the live probe did not learn"
         )
+    return record
+
+
+def recipe_gate_record(artifact, bars=None):
+    """Gate decision for one recipes_eval artifact (pure — tested without a
+    driver run).
+
+    Binds on EVERY device: the supcon-refactor BIT-IDENTITY (the recipe
+    interface must be numerically invisible — the contract that carries
+    every committed accuracy ratchet across the refactor) under both host
+    and device placement, a consistent health stream per recipe, and ZERO
+    collapse alarms (an alarm on a healthy tiny run is the false positive
+    that would abort real runs under --health_policy abort). The
+    per-recipe online-probe learning bars bind on CPU only (where
+    :data:`RECIPE_PROBE_CPU_BARS` was calibrated); elsewhere they
+    pass-skip with the reason on record — the bench-gate convention.
+    """
+    if bars is None:
+        bars = RECIPE_PROBE_CPU_BARS
+    bit = artifact.get("bit_identity", {})
+    recipes = artifact.get("recipes", {})
+    record = {
+        "metric": "ratchet_recipes",
+        "value": {
+            name: (rec or {}).get("probe_best_top1")
+            for name, rec in recipes.items()
+        },
+        "bars": bars,
+        "bit_identity": bit.get("placements"),
+        "alarms": {n: (r or {}).get("alarms") for n, r in recipes.items()},
+        "device": artifact.get("device"),
+    }
+
+    def fail(msg):
+        record["ok"] = False
+        record["error"] = msg
+        return record
+
+    if not bit.get("ok") or set(bit.get("placements", {})) != {"host",
+                                                               "device"}:
+        return fail(
+            "supcon-refactor bit-identity failed or incomplete: "
+            f"{bit.get('placements')}"
+        )
+    missing = sorted(set(bars) - set(recipes))
+    if missing:
+        return fail(f"recipe arms missing from the artifact: {missing}")
+    for name in sorted(bars):
+        rec = recipes[name] or {}
+        if not rec.get("consistency_ok"):
+            return fail(f"recipe {name!r}: inconsistent health stream")
+        if rec.get("alarms"):
+            return fail(
+                f"recipe {name!r}: collapse detector fired "
+                f"{rec['alarms']}x on the healthy run (false positive)"
+            )
+        if rec.get("probe_best_top1") is None:
+            return fail(f"recipe {name!r}: no online-probe columns")
+    if artifact.get("device") != "cpu":
+        record["ok"] = True
+        record["skipped"] = (
+            f"device {artifact.get('device')!r}: probe bars calibrated "
+            "for the CPU smoke only; bit-identity and zero-alarm checks "
+            "still enforced"
+        )
+        return record
+    for name, bar in sorted(bars.items()):
+        best = recipes[name]["probe_best_top1"]
+        if best < bar:
+            return fail(
+                f"recipe {name!r}: online probe best top-1 {best:.2f} < "
+                f"{bar:g} — the recipe did not learn through the substrate"
+            )
+    record["ok"] = True
     return record
 
 
@@ -624,6 +725,37 @@ def run_config(name, spec, epochs, bar, args):
         print(json.dumps(record), flush=True)
         return record
 
+    if kind == "recipes":
+        # the SSL-recipe gate: recipes_eval --smoke runs every recipe
+        # through the real driver + the supcon bit-identity A/B, then the
+        # pure recipe_gate_record judges the artifact (CONFIGS note)
+        ev_json = os.path.join(logs, "recipes_eval.json")
+        ev_log = os.path.join(logs, "recipes_eval.log")
+        try:
+            run(
+                [sys.executable, "scripts/recipes_eval.py", "--smoke",
+                 "--json", ev_json, "--seed", str(args.seed),
+                 "--trial", trial,
+                 "--workdir", os.path.join(args.workdir, f"recipes_{trial}")],
+                ev_log,
+            )
+        except ConfigFailed:
+            # recipes_eval exits nonzero on a failed claim but still writes
+            # the artifact — fall through so the gate record carries the
+            # structured verdict (the health_report convention)
+            if not os.path.exists(ev_json):
+                raise
+        try:
+            with open(ev_json) as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ConfigFailed(f"recipes_eval wrote no artifact: {e}") from e
+        record = recipe_gate_record(artifact)
+        record["bar"] = bar
+        record["log"] = ev_log
+        print(json.dumps(record), flush=True)
+        return record
+
     if kind == "supervisor_gate":
         # binds on the COMMITTED scenario-matrix evidence artifact (see the
         # CONFIGS note): no subprocess — the matrix itself is re-run with
@@ -745,6 +877,8 @@ def main():
                 metric = "ratchet_health_report"
             elif spec["kind"] == "supervisor_gate":
                 metric = "ratchet_supervisor_matrix"
+            elif spec["kind"] == "recipes":
+                metric = "ratchet_recipes"
             elif spec["kind"] in ("resident_ab", "window_ab"):
                 metric = f"ratchet_{spec['kind']}_equivalence"
             else:

@@ -153,6 +153,29 @@ class SupConConfig:
     # the post-hoc main_linear.py pass; checkpointed in its own payload
     online_probe: str = "off"
     probe_lr: float = 0.1
+    # --- SSL recipes (simclr_pytorch_distributed_tpu/recipes/) ---
+    # which loss head rides the substrate: 'auto' = the --method-matching
+    # contrastive recipe (the pre-recipe behavior); 'supcon'/'simclr' force
+    # the method; 'byol'/'simsiam'/'vicreg' are the negative-free /
+    # redundancy-reduction siblings (validate_recipe resolves + checks the
+    # flag interactions at parse time)
+    recipe: str = "auto"
+    # MoCo-style device-side negative queue (recipes/supcon.py): K past
+    # embeddings contrasted as extra negatives, rotated in-program — simclr
+    # only, K a multiple of 2*batch_size, dense loss path; 0 = off
+    moco_queue: int = 0
+    # EMA momentum of the slow branch: byol's target network AND the moco
+    # queue's key encoder (tau/m; slow = tau*slow + (1-tau)*online per step)
+    ema_momentum: float = 0.996
+    # byol: 'none' ablates the predictor — the known-collapsing form that
+    # must trip the eff-rank collapse alarm (the recipes' injection arm)
+    byol_predictor: str = "mlp"
+    # byol/simsiam predictor hidden width (models/heads.PredictorHead)
+    predictor_hidden: int = 512
+    # vicreg term weights (ops/losses.vicreg_loss; paper defaults 25/25/1)
+    vicreg_sim_coeff: float = 25.0
+    vicreg_std_coeff: float = 25.0
+    vicreg_cov_coeff: float = 1.0
     # flight recorder (utils/tracing.py): host-boundary span/event log ->
     # <run_dir>/events.jsonl + Chrome-trace trace.json; zero device
     # syncs/transfers added (asserted mechanically in tier-1)
@@ -372,6 +395,38 @@ def supcon_parser() -> argparse.ArgumentParser:
                         "RepresentationHealthError (exit code 3 — the "
                         "supervisor gives up rather than retrying, since "
                         "collapse lives in the weights; never rolled back)")
+    p.add_argument("--recipe", type=str, default=d.recipe,
+                   choices=["auto", "supcon", "simclr", "byol", "simsiam",
+                            "vicreg"],
+                   help="SSL loss head (recipes/): 'auto' = the --method-"
+                        "matching contrastive recipe; supcon/simclr force "
+                        "the method; byol = predictor + EMA target; simsiam "
+                        "= predictor + stop-gradient; vicreg = invariance/"
+                        "variance/covariance")
+    p.add_argument("--moco_queue", type=nonnegative_int_arg("moco_queue"),
+                   default=d.moco_queue,
+                   help="MoCo-style negative queue: an EMA key encoder + a "
+                        "device-side ring of K past keys as extra NT-Xent "
+                        "negatives, rotated in-program (simclr recipe only; "
+                        "K a multiple of 2*batch_size; dense loss path); "
+                        "0=off")
+    p.add_argument("--ema_momentum", type=float, default=d.ema_momentum,
+                   help="EMA momentum in [0, 1) of the slow branch: byol's "
+                        "target network / the moco queue's key encoder")
+    p.add_argument("--byol_predictor", type=str, default=d.byol_predictor,
+                   choices=["mlp", "none"],
+                   help="byol predictor head; 'none' ablates it (the known-"
+                        "collapsing form — the collapse-injection arm)")
+    p.add_argument("--predictor_hidden",
+                   type=positive_int_arg("predictor_hidden"),
+                   default=d.predictor_hidden,
+                   help="byol/simsiam predictor MLP hidden width")
+    p.add_argument("--vicreg_sim_coeff", type=float, default=d.vicreg_sim_coeff,
+                   help="vicreg invariance weight (paper: 25)")
+    p.add_argument("--vicreg_std_coeff", type=float, default=d.vicreg_std_coeff,
+                   help="vicreg variance-hinge weight (paper: 25)")
+    p.add_argument("--vicreg_cov_coeff", type=float, default=d.vicreg_cov_coeff,
+                   help="vicreg covariance weight (paper: 1)")
     p.add_argument("--online_probe", type=str, default=d.online_probe,
                    choices=["on", "off"],
                    help="train a detached linear probe on stop_gradient "
@@ -448,6 +503,73 @@ def validate_data_placement(dataset: str, data_placement: str) -> None:
         )
 
 
+def validate_recipe(cfg: SupConConfig) -> None:
+    """Resolve ``--recipe auto`` and check the recipe flag interactions at
+    PARSE time (the --ngpu convention: these feed tree geometry and loss
+    kernels where a bad value fails far from the flag).
+
+    Mutates ``cfg.recipe`` to the concrete name and, for the contrastive
+    recipes, forces ``cfg.method`` to match (``--recipe`` is the outer
+    selector; a method the recipe contradicts is an error only for the
+    label-free recipes, where an explicit ``--method SupCon`` would be
+    silently meaningless).
+    """
+    if cfg.recipe == "auto":
+        cfg.recipe = "supcon" if cfg.method == "SupCon" else "simclr"
+    elif cfg.recipe == "supcon":
+        # forcing the method here is unambiguous: --method defaults to
+        # SimCLR, so a SimCLR value cannot be distinguished from "not given"
+        cfg.method = "SupCon"
+    elif cfg.recipe == "simclr":
+        if cfg.method == "SupCon":
+            # SupCon is NOT the --method default, so this is an explicit,
+            # contradictory ask — dropping the labels silently would train
+            # unsupervised while the user believes otherwise
+            raise ValueError(
+                "--recipe simclr contradicts --method SupCon (the recipe "
+                "is label-free NT-Xent) — drop --method, or use "
+                "--recipe supcon"
+            )
+        cfg.method = "SimCLR"
+    else:  # byol / simsiam / vicreg: label-free
+        if cfg.method == "SupCon":
+            raise ValueError(
+                f"--recipe {cfg.recipe} is label-free; --method SupCon has "
+                "no effect there — drop the flag (or use --recipe supcon)"
+            )
+    if cfg.moco_queue:
+        if cfg.recipe != "simclr":
+            raise ValueError(
+                f"--moco_queue holds NEGATIVES only, which --recipe "
+                f"{cfg.recipe} cannot use "
+                + ("(supervised positives may sit in the queue)"
+                   if cfg.recipe == "supcon" else "(no contrastive term)")
+                + " — it requires --recipe simclr"
+            )
+        if cfg.moco_queue % (2 * cfg.batch_size) != 0:
+            raise ValueError(
+                f"--moco_queue {cfg.moco_queue} must be a multiple of "
+                f"2*batch_size ({2 * cfg.batch_size}): the in-program ring "
+                "write (dynamic_update_slice) clamps at the edge instead of "
+                "wrapping, so partial-batch rotations would corrupt the queue"
+            )
+        if cfg.loss_impl in ("fused", "ring"):
+            raise ValueError(
+                f"--moco_queue extends the contrast side past the fixed "
+                f"2B geometry the {cfg.loss_impl!r} kernel tiles — use "
+                "--loss_impl dense (or auto, which resolves to dense)"
+            )
+    if not 0.0 <= cfg.ema_momentum < 1.0:
+        raise ValueError(
+            f"--ema_momentum must be in [0, 1), got {cfg.ema_momentum}"
+        )
+    for name in ("vicreg_sim_coeff", "vicreg_std_coeff", "vicreg_cov_coeff"):
+        if getattr(cfg, name) < 0:
+            raise ValueError(
+                f"--{name} must be >= 0, got {getattr(cfg, name)}"
+            )
+
+
 def parse_supcon(argv=None) -> SupConConfig:
     ns = supcon_parser().parse_args(argv)
     kwargs = vars(ns)
@@ -459,6 +581,7 @@ def parse_supcon(argv=None) -> SupConConfig:
 def finalize_supcon(cfg: SupConConfig, make_dirs: bool = True) -> SupConConfig:
     """Derived fields, replicating main_supcon.py:92-150."""
     validate_data_placement(cfg.dataset, cfg.data_placement)
+    validate_recipe(cfg)
     if cfg.dataset == "path":
         assert cfg.data_folder is not None and cfg.mean is not None and cfg.std is not None
     if cfg.data_folder is None:

@@ -66,6 +66,42 @@ class ProjectionHead(nn.Module):
         raise NotImplementedError(f"head not supported: {self.head}")
 
 
+class PredictorHead(nn.Module):
+    """BYOL/SimSiam prediction MLP over the projector output
+    (dim_out -> hidden -> batch-norm -> ReLU -> dim_out).
+
+    The asymmetric half of the negative-free recipes
+    (simclr_pytorch_distributed_tpu/recipes/): the online branch predicts the
+    (stop-gradient) target/sibling projection through this head, which is
+    what keeps those losses from collapsing — ablating it is the recipes'
+    collapse-injection arm (``--byol_predictor none``). The hidden-layer
+    batch normalization is the papers' own (BYOL §3.3 / SimSiam §4.4 name
+    it as stability-critical, and this repo MEASURED the BN-free variant
+    collapsing within 2 tiny epochs — the detector caught it); it
+    normalizes by the CURRENT batch's statistics with no running-stat
+    tracking, because the predictor only ever runs in train mode — which
+    keeps the head's variables in ``params`` alone (no ``batch_stats``
+    collection riding the recipe slots).
+    """
+
+    dim_hidden: int = 512
+    dim_out: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, z: jax.Array) -> jax.Array:
+        h = TorchDense(self.dim_hidden, dtype=self.dtype, name="fc1")(z)
+        mean = jnp.mean(h, axis=0, keepdims=True)
+        var = jnp.var(h, axis=0, keepdims=True)
+        h = (h - mean) / jnp.sqrt(var + 1e-5)
+        h = h * self.param("bn_scale", nn.initializers.ones,
+                           (self.dim_hidden,))
+        h = h + self.param("bn_bias", nn.initializers.zeros,
+                           (self.dim_hidden,))
+        h = nn.relu(h)
+        return TorchDense(self.dim_out, dtype=self.dtype, name="fc2")(h)
+
+
 class SupConResNet(nn.Module):
     """Backbone + projection head (reference resnet_big.py:159-181)."""
 

@@ -107,6 +107,142 @@ def supcon_loss(
     return jnp.mean(loss.reshape(anchor_count, batch_size))
 
 
+def l2_normalize(x: jax.Array, eps: float = 1e-12) -> jax.Array:
+    """Row-wise L2 normalization with a zero-row guard — the recipe losses'
+    shared normalizer (byol/simsiam here, the MoCo key branch in
+    recipes/supcon.py). The CONTRASTIVE path deliberately does not use it:
+    its bare ``feats / norm(feats)`` expression is pinned bitwise against
+    the pre-recipe step (docs/PARITY.md)."""
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), eps)
+
+
+def _cross_views(x: jax.Array) -> jax.Array:
+    """Swap the two view blocks of a view-major ``[2B, D]`` matrix, so row
+    ``i`` lands on its positive's row ``(i + B) % 2B`` (the train step's
+    two-crop layout, train/supcon_step.two_view_forward)."""
+    b = x.shape[0] // 2
+    return jnp.concatenate([x[b:], x[:b]], axis=0)
+
+
+def byol_loss(online_pred: jax.Array, target_proj: jax.Array) -> jax.Array:
+    """BYOL regression loss (Grill et al. 2020, eq. 2), symmetrized.
+
+    ``online_pred`` is the online branch's predictor output and
+    ``target_proj`` the EMA target network's projection, both ``[2B, D]``
+    view-major and UNNORMALIZED (normalization happens here, like the
+    contrastive path normalizes post-gather). The caller stop-gradients the
+    target. Each row regresses onto the OTHER view's target row; with both
+    sides unit-norm the squared error is ``2 - 2 cos``, so perfect
+    alignment gives 0 and orthogonal views give 2.
+    """
+    p = l2_normalize(online_pred.astype(jnp.float32))
+    t = _cross_views(l2_normalize(target_proj.astype(jnp.float32)))
+    return jnp.mean(jnp.sum(jnp.square(p - t), axis=1))
+
+
+def simsiam_loss(pred: jax.Array, proj: jax.Array) -> jax.Array:
+    """SimSiam negative-cosine loss (Chen & He 2021, eq. 1), symmetrized.
+
+    ``pred = h(f(x))`` and ``proj = f(x)`` are the SAME branch's predictor
+    output and projection (``[2B, D]`` view-major, unnormalized); the
+    stop-gradient on the projection side — the paper's whole mechanism — is
+    applied HERE so no caller can forget it. Bounded in ``[-1, 0]`` at
+    perfect alignment.
+    """
+    p = l2_normalize(pred.astype(jnp.float32))
+    z = jax.lax.stop_gradient(
+        _cross_views(l2_normalize(proj.astype(jnp.float32)))
+    )
+    return -jnp.mean(jnp.sum(p * z, axis=1))
+
+
+def vicreg_loss(
+    z1: jax.Array,
+    z2: jax.Array,
+    *,
+    sim_coeff: float = 25.0,
+    std_coeff: float = 25.0,
+    cov_coeff: float = 1.0,
+    eps: float = 1e-4,
+):
+    """VICReg (Bardes et al. 2022): invariance + variance + covariance.
+
+    ``z1``/``z2`` are the two views' UNNORMALIZED projections ``[B, D]``
+    (VICReg never L2-normalizes — the variance hinge needs the raw scale).
+    Returns ``(loss, parts)`` where ``parts`` carries the three unweighted
+    terms under the recipe metric keys (``vicreg_inv``/``vicreg_var``/
+    ``vicreg_cov``), streamed through the metric ring so a collapsing
+    variance term is visible live. The covariance penalty reuses the
+    health diagnostics' covariance construction
+    (ops/metrics.embedding_covariance, centered/unbiased here).
+    """
+    from simclr_pytorch_distributed_tpu.ops.metrics import embedding_covariance
+
+    z1 = z1.astype(jnp.float32)
+    z2 = z2.astype(jnp.float32)
+    d = z1.shape[1]
+    inv = jnp.mean(jnp.square(z1 - z2))
+    var_terms = []
+    cov_terms = []
+    for z in (z1, z2):
+        std = jnp.sqrt(jnp.var(z, axis=0) + eps)
+        var_terms.append(jnp.mean(jax.nn.relu(1.0 - std)))
+        cov = embedding_covariance(z, center=True, ddof=1)
+        off_diag = cov - jnp.diag(jnp.diag(cov))
+        cov_terms.append(jnp.sum(jnp.square(off_diag)) / d)
+    var = 0.5 * (var_terms[0] + var_terms[1])
+    cov = 0.5 * (cov_terms[0] + cov_terms[1])
+    loss = sim_coeff * inv + std_coeff * var + cov_coeff * cov
+    parts = {"vicreg_inv": inv, "vicreg_var": var, "vicreg_cov": cov}
+    return loss, parts
+
+
+def moco_queue_loss(
+    query: jax.Array,
+    key: jax.Array,
+    queue: jax.Array,
+    *,
+    temperature: float = 0.07,
+    base_temperature: float = 0.07,
+) -> jax.Array:
+    """MoCo-style NT-Xent: online queries against momentum-encoder keys +
+    a negative queue of PAST keys.
+
+    ``query`` is the online branch's L2-normalized view-major ``[2B, D]``
+    matrix, ``key`` the EMA key encoder's matching ``[2B, D]`` embeddings
+    (the caller stop-gradients them — keys never backprop, He et al. 2020),
+    and ``queue`` the ``[K, D]`` ring of past keys (recipes/supcon.py
+    rotates it in-program), negatives only. Row ``i``'s positive is the
+    key of its OTHER view, ``key[(i + B) % 2B]``; its own view's key
+    (column ``i`` — the same image through two near-identical encoders) is
+    masked like the SimCLR self-pair. The momentum encoder is load-bearing,
+    not decorative: enqueueing ONLINE embeddings instead reproduces the
+    MoCo paper's ``m = 0`` failure — the one-sided repulsion from the
+    rapidly-moving self-cluster collapses the representation within an
+    epoch at this repo's scale (measured; recipes/supcon.py docstring).
+    Mirrors ``supcon_loss``'s op sequence (detached row-max subtraction,
+    self masking, the ``-(T / base_T)`` scale), so with ``K = 0`` and
+    ``key == query`` it degenerates to the SimCLR loss exactly.
+    """
+    n = query.shape[0]
+    b = n // 2
+    contrast = jnp.concatenate([key, queue.astype(query.dtype)], axis=0)
+    logits = (query @ contrast.T) / temperature
+    logits = logits - jax.lax.stop_gradient(
+        jnp.max(logits, axis=1, keepdims=True)
+    )
+    idx = jnp.arange(n)
+    # column i = MY OWN view's key (sim ~ 1 across the two encoders): a
+    # false negative, masked exactly like the SimCLR self-pair diagonal;
+    # queue columns are always valid contrast
+    logits_mask = jnp.ones_like(logits).at[idx, idx].set(0.0)
+    exp_logits = jnp.exp(logits) * logits_mask
+    log_prob = logits - jnp.log(jnp.sum(exp_logits, axis=1, keepdims=True))
+    pos_idx = (idx + b) % n
+    loss = -(temperature / base_temperature) * log_prob[idx, pos_idx]
+    return jnp.mean(loss)
+
+
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Mean softmax cross-entropy with integer labels (the CE-baseline loss).
 

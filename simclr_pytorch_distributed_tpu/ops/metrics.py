@@ -31,6 +31,23 @@ def topk_accuracy(
     return tuple(res)
 
 
+def embedding_covariance(
+    emb: jax.Array, center: bool = False, ddof: int = 0
+) -> jax.Array:
+    """``[D, D]`` (co)variance matrix of an ``[N, D]`` embedding batch.
+
+    One covariance construction shared by the two consumers that must agree
+    on it: the health diagnostics' effective-rank spectrum
+    (train/supcon_step.contrastive_health_metrics — UNCENTERED second moment,
+    ``center=False, ddof=0``, the PR-8 definition kept bitwise) and the
+    VICReg covariance penalty (ops/losses.vicreg_loss — centered, unbiased:
+    ``center=True, ddof=1``, the paper's estimator).
+    """
+    if center:
+        emb = emb - jnp.mean(emb, axis=0, keepdims=True)
+    return emb.T @ emb / (emb.shape[0] - ddof)
+
+
 def topk_correct(logits: jax.Array, labels: jax.Array, ks=(1, 5)):
     """Per-batch top-k correct counts (sum-able across shards/batches).
 

@@ -1,0 +1,87 @@
+"""The Recipe protocol: what a self-supervised loss head must provide to ride
+the existing substrate (two-view pipeline, device/window stores, zero-sync
+metric ring, online probe, health monitor, checkpoint/ratchet discipline).
+
+A recipe is a frozen, trace-time-static object the step builder
+(train/supcon_step.make_train_step) closes over. It contributes three things,
+all inside the ONE compiled update:
+
+- ``loss(cfg, mesh, fused_on_mesh, ctx)`` — the per-step loss term computed
+  from the step's own forward products (a :class:`RecipeContext`), plus an
+  aux dict: entries named in ``metric_keys`` stream through the metric ring
+  (zero new transfers), and the reserved ``"recipe_embeddings"`` entry is the
+  detached payload ``post_step`` rotates into the queue;
+- extra TRAINABLE state — ``trainable=True`` recipes (BYOL/SimSiam predictor
+  heads) ride ``TrainState.recipe_params`` under their own optimizer chain
+  (``self.tx``), differentiated JOINTLY with the encoder so predictor
+  gradients reach the backbone;
+- ``post_step(recipe_state, new_params=, aux=)`` — the non-gradient state
+  transition (BYOL EMA target update, MoCo queue rotation) applied to
+  ``TrainState.recipe_state`` after the optimizer step, still in-program.
+
+``init_slots`` builds the initial ``(recipe_params, recipe_opt_state,
+recipe_state)`` triple; all-``None`` (the contrastive recipes without a
+queue) keeps the state tree, checkpoint layout, and jit cache keys exactly
+the pre-recipe ones — the online probe's slot contract. Non-``None`` slots
+are checkpointed as their own ``recipe`` payload (utils/checkpoint.py) keyed
+by the recipe name recorded in checkpoint meta, so cross-recipe resumes
+degrade loudly to fresh slots instead of restoring a mismatched tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import optax
+
+# re-exported: the context dataclass lives beside the step that builds it
+# (train/supcon_step.py) so the step module never imports recipes/ (the
+# recipe implementations import the step's shared contrastive term, and an
+# import in the other direction would cycle through this package's __init__)
+from simclr_pytorch_distributed_tpu.train.supcon_step import (  # noqa: F401
+    RecipeContext,
+)
+
+RecipeSlots = Tuple[Any, Any, Any]  # (recipe_params, recipe_opt_state, recipe_state)
+
+
+@dataclasses.dataclass(frozen=True)
+class Recipe:
+    """Base recipe: no extra slots, no extra metrics, no post-step.
+
+    Subclasses override what they need; the defaults make "a loss term and
+    nothing else" the cheapest possible recipe. ``tx`` is the trainable
+    recipes' own optimizer chain (built by recipes.build_recipe from the
+    run's schedule/momentum/weight-decay, so a predictor trains under the
+    same recipe hyperparameters as the encoder unless a recipe says
+    otherwise).
+    """
+
+    name: str = "recipe"
+    # True -> state.recipe_params exists and is differentiated jointly with
+    # the encoder, updated by self.tx inside the same compiled step
+    trainable: bool = False
+    # extra ring columns this recipe streams (sorted into the run's key
+    # tuple by train/supcon_step.metric_keys — writer and reader derive the
+    # same layout, so a mismatch fails loudly at trace time)
+    metric_keys: Tuple[str, ...] = ()
+    tx: Optional[optax.GradientTransformation] = None
+
+    def init_slots(self, model, params, batch_stats, rng) -> RecipeSlots:
+        """Initial ``(recipe_params, recipe_opt_state, recipe_state)``.
+        All-None by default: the state tree stays exactly the pre-recipe
+        one."""
+        return None, None, None
+
+    def loss(self, cfg, mesh, fused_on_mesh, ctx: RecipeContext):
+        """``(loss_term, aux)`` for one step; runs INSIDE the jitted update.
+        ``aux`` entries named in ``self.metric_keys`` stream through the
+        metric ring; the reserved ``"recipe_embeddings"`` entry feeds
+        ``post_step``."""
+        raise NotImplementedError
+
+    def post_step(self, recipe_state, *, new_params, aux):
+        """The post-optimizer state transition (EMA, queue rotation); called
+        only when ``recipe_state`` is not None. Default: carry unchanged."""
+        return recipe_state

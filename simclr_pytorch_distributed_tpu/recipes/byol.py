@@ -1,0 +1,82 @@
+"""BYOL (Grill et al. 2020): predictor head + EMA target network.
+
+The online branch is the step's existing encoder+projector forward; this
+recipe adds the predictor as ``recipe_params`` (trained jointly — BYOL's
+encoder receives gradients only through the predictor path) and the EMA
+target network as ``recipe_state["target_params"]``, a full copy of the
+online params tree transitioned post-step with
+``target = tau * target + (1 - tau) * online``. No negatives anywhere: the
+PR-8 collapse detector is the only thing standing between this recipe and
+the degenerate constant solution, which is exactly why its health
+thresholds are tightened (utils/guard.RECIPE_HEALTH_THRESHOLDS) and why the
+ablation arm exists — ``predictor='none'`` removes the asymmetry that
+prevents collapse, and the collapse-injection test drives that arm into the
+typed code-3 abort.
+
+The target forward runs in train mode (batch statistics, like the online
+branch; its BN-stat mutation is discarded), so the target network is the
+EMA of params only — no separate running-stat EMA to checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from simclr_pytorch_distributed_tpu.ops.losses import byol_loss
+from simclr_pytorch_distributed_tpu.recipes.base import Recipe, RecipeContext
+from simclr_pytorch_distributed_tpu.train.supcon_step import two_view_forward
+
+
+@dataclasses.dataclass(frozen=True)
+class BYOLRecipe(Recipe):
+    name: str = "byol"
+    predictor: Any = None  # models/heads.PredictorHead, None = ablated
+    ema_momentum: float = 0.996
+    trainable: bool = dataclasses.field(default=False)
+
+    def __post_init__(self):
+        object.__setattr__(self, "trainable", self.predictor is not None)
+
+    def init_slots(self, model, params, batch_stats, rng):
+        recipe_params = None
+        opt_state = None
+        if self.predictor is not None:
+            feat_dim = self.predictor.dim_out
+            recipe_params = self.predictor.init(
+                rng, jnp.zeros((2, feat_dim))
+            )["params"]
+            opt_state = self.tx.init(recipe_params)
+        # the target starts as an exact COPY of the online network (the
+        # paper's initialization) — a real copy, not jnp.asarray: aliasing
+        # the online buffers would make the donating update hand the same
+        # buffer to XLA twice (donate(a), donate(a) -> runtime error)
+        target = jax.tree.map(jnp.copy, params)
+        return recipe_params, opt_state, {"target_params": target}
+
+    def _predict(self, recipe_params, z):
+        if self.predictor is None:
+            return z  # the ablation arm: BYOL without its asymmetry
+        return self.predictor.apply({"params": recipe_params}, z)
+
+    def loss(self, cfg, mesh, fused_on_mesh, ctx: RecipeContext):
+        q = self._predict(ctx.recipe_params, ctx.feats)
+        # the target branch: SECOND forward through the EMA params (train
+        # mode, like the online branch; mutated BN stats discarded)
+        target_feats, _ = two_view_forward(
+            ctx.model, ctx.recipe_state["target_params"], ctx.batch_stats,
+            ctx.images, train=True,
+        )
+        zt = jax.lax.stop_gradient(target_feats.astype(jnp.float32))
+        return byol_loss(q, zt), {}
+
+    def post_step(self, recipe_state, *, new_params, aux):
+        tau = self.ema_momentum
+        target = jax.tree.map(
+            lambda t, o: tau * t + (1.0 - tau) * o,
+            recipe_state["target_params"], new_params,
+        )
+        return {"target_params": target}

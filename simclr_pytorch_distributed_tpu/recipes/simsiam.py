@@ -1,0 +1,37 @@
+"""SimSiam (Chen & He 2021): predictor + stop-gradient, no EMA.
+
+The minimal negative-free recipe: one branch, the predictor as
+``recipe_params`` (joint gradient with the encoder), and the stop-gradient
+on the projection side applied inside ``ops/losses.simsiam_loss`` — no
+target network, no ``recipe_state``, no momentum hyperparameter. What keeps
+it from collapsing is ONLY the predictor asymmetry + stop-gradient, so like
+BYOL it runs under the tightened collapse thresholds
+(utils/guard.RECIPE_HEALTH_THRESHOLDS).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from simclr_pytorch_distributed_tpu.ops.losses import simsiam_loss
+from simclr_pytorch_distributed_tpu.recipes.base import Recipe, RecipeContext
+
+
+@dataclasses.dataclass(frozen=True)
+class SimSiamRecipe(Recipe):
+    name: str = "simsiam"
+    predictor: Any = None  # models/heads.PredictorHead (required)
+    trainable: bool = True
+
+    def init_slots(self, model, params, batch_stats, rng):
+        import jax.numpy as jnp
+
+        recipe_params = self.predictor.init(
+            rng, jnp.zeros((2, self.predictor.dim_out))
+        )["params"]
+        return recipe_params, self.tx.init(recipe_params), None
+
+    def loss(self, cfg, mesh, fused_on_mesh, ctx: RecipeContext):
+        pred = self.predictor.apply({"params": ctx.recipe_params}, ctx.feats)
+        return simsiam_loss(pred, ctx.feats), {}

@@ -1,0 +1,130 @@
+"""The contrastive recipes (supcon / simclr) behind the Recipe interface,
+plus the MoCo-style momentum-encoder negative queue.
+
+``ContrastiveRecipe`` is the repo's original behavior refactored behind the
+interface: its ``loss`` delegates to the SAME
+``train/supcon_step.contrastive_loss_terms`` the pre-recipe inline step uses
+(verbatim-extracted, one implementation), so ``--recipe supcon`` is proven
+BITWISE-identical to the pre-refactor update driver-level
+(tests/test_recipes.py, docs/PARITY.md). Without a queue it contributes no
+slots at all — state tree, checkpoints, and jit keys are exactly the
+pre-recipe ones.
+
+``--moco_queue K`` (simclr only — the queue holds negatives ONLY, which is
+unsound under supervised positives) turns the recipe into MoCo (He et al.
+2020): ``recipe_state`` carries an EMA **key encoder** (``key_params``, the
+BYOL target-network pattern, momentum ``--ema_momentum``) plus a donated
+device-side ring of its past keys — the MetricRing pattern applied to
+negatives. Each step runs a second forward through the key encoder; the
+loss contrasts online queries against the keys + the ring
+(ops/losses.moco_queue_loss), and ``post_step`` rotates the batch's
+detached keys in with ``dynamic_update_slice`` at the carried pointer and
+EMA-advances the key encoder — all inside the one compiled program, so the
+hot loop gains no per-step host traffic (the zero-sync transfer-count
+proof re-runs with the queue on). The momentum encoder is NOT optional
+garnish: enqueueing online embeddings instead (``m = 0``, the MoCo paper's
+failure ablation) measurably collapses this repo's tiny-scale runs within
+an epoch — the one-sided repulsion from the rapidly-moving self-cluster is
+an instability the slow key encoder exists to remove.
+
+``K`` must be a multiple of ``2B`` (config.validate_recipe) so ring writes
+never straddle the edge (``dynamic_update_slice`` clamps rather than
+wraps). Cold start: seeded L2-normalized gaussian rows, the MoCo
+convention, so the loss is well-formed from step 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from simclr_pytorch_distributed_tpu.ops.losses import (
+    l2_normalize,
+    moco_queue_loss,
+)
+from simclr_pytorch_distributed_tpu.recipes.base import Recipe, RecipeContext
+from simclr_pytorch_distributed_tpu.train.supcon_step import (
+    contrastive_loss_terms,
+    two_view_forward,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ContrastiveRecipe(Recipe):
+    """supcon/simclr behind the interface; ``moco_queue > 0`` adds the
+    momentum key encoder + negative ring."""
+
+    name: str = "simclr"
+    moco_queue: int = 0
+    feat_dim: int = 128
+    queue_seed: int = 0
+    # key-encoder EMA momentum (MoCo's m; shared --ema_momentum flag)
+    ema_momentum: float = 0.996
+
+    def init_slots(self, model, params, batch_stats, rng):
+        if not self.moco_queue:
+            return None, None, None
+        q = l2_normalize(jax.random.normal(
+            rng, (self.moco_queue, self.feat_dim), jnp.float32
+        ))
+        # the key encoder starts as a real COPY of the online network (not
+        # an alias — the donating update would hand XLA the same buffer
+        # twice; recipes/byol.py has the same note)
+        key_params = jax.tree.map(jnp.copy, params)
+        return None, None, {
+            "queue_emb": q, "queue_ptr": jnp.zeros((), jnp.int32),
+            "key_params": key_params,
+        }
+
+    def loss(self, cfg, mesh, fused_on_mesh, ctx: RecipeContext):
+        if cfg.method not in ("SupCon", "SimCLR"):
+            raise ValueError(f"contrastive method not supported: {cfg.method}")
+        loss_labels = ctx.labels if cfg.method == "SupCon" else None
+        if not self.moco_queue:
+            return contrastive_loss_terms(
+                cfg, mesh, fused_on_mesh, ctx.n_fea, loss_labels
+            ), {}
+        if cfg.loss_impl != "dense":
+            # the fused/ring kernels tile the fixed 2B x 2B geometry; the
+            # queue extends the contrast side to 2B + K, which only the
+            # dense path implements (config resolves 'auto' here)
+            raise ValueError(
+                f"--moco_queue needs loss_impl='dense', got {cfg.loss_impl!r}"
+            )
+        # keys: second forward through the EMA key encoder (train mode,
+        # like the online branch; mutated BN stats discarded), normalized
+        # and detached — keys never backprop (He et al. 2020)
+        key_feats, _ = two_view_forward(
+            ctx.model, ctx.recipe_state["key_params"], ctx.batch_stats,
+            ctx.images, train=True,
+        )
+        keys = jax.lax.stop_gradient(
+            l2_normalize(key_feats.astype(jnp.float32))
+        )
+        loss = moco_queue_loss(
+            ctx.n_fea, keys, ctx.recipe_state["queue_emb"],
+            temperature=cfg.temperature,
+            base_temperature=cfg.base_temperature,
+        )
+        # the rotation payload: the KEYS (already detached) — the ring only
+        # ever holds momentum-encoder embeddings
+        return loss, {"recipe_embeddings": keys}
+
+    def post_step(self, recipe_state, *, new_params, aux):
+        if not self.moco_queue:
+            return recipe_state
+        emb = aux["recipe_embeddings"]  # [2B, D] keys
+        ptr = recipe_state["queue_ptr"]
+        queue = jax.lax.dynamic_update_slice(
+            recipe_state["queue_emb"], emb, (ptr, jnp.zeros((), jnp.int32))
+        )
+        new_ptr = (ptr + emb.shape[0]) % self.moco_queue
+        m = self.ema_momentum
+        key_params = jax.tree.map(
+            lambda k, o: m * k + (1.0 - m) * o,
+            recipe_state["key_params"], new_params,
+        )
+        return {"queue_emb": queue, "queue_ptr": new_ptr,
+                "key_params": key_params}

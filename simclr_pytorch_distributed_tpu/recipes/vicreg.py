@@ -1,0 +1,39 @@
+"""VICReg (Bardes et al. 2022): invariance + variance + covariance.
+
+No predictor, no EMA, no negatives — collapse is prevented in the loss
+itself (the variance hinge), which makes this the one recipe whose health
+story is "the detector should NEVER fire" (default thresholds,
+utils/guard.RECIPE_HEALTH_THRESHOLDS). The covariance penalty reuses the
+PR-8 covariance construction (ops/metrics.embedding_covariance) the health
+diagnostics' effective-rank spectrum is built on. The three unweighted
+terms stream through the metric ring as recipe columns
+(``vicreg_inv``/``vicreg_var``/``vicreg_cov``) so a decaying variance term
+is visible live and in ``scripts/health_report.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from simclr_pytorch_distributed_tpu.ops.losses import vicreg_loss
+from simclr_pytorch_distributed_tpu.recipes.base import Recipe, RecipeContext
+
+VICREG_METRIC_KEYS = ("vicreg_cov", "vicreg_inv", "vicreg_var")
+
+
+@dataclasses.dataclass(frozen=True)
+class VICRegRecipe(Recipe):
+    name: str = "vicreg"
+    sim_coeff: float = 25.0
+    std_coeff: float = 25.0
+    cov_coeff: float = 1.0
+    metric_keys: tuple = VICREG_METRIC_KEYS
+
+    def loss(self, cfg, mesh, fused_on_mesh, ctx: RecipeContext):
+        b = ctx.feats.shape[0] // 2
+        loss, parts = vicreg_loss(
+            ctx.feats[:b], ctx.feats[b:],
+            sim_coeff=self.sim_coeff, std_coeff=self.std_coeff,
+            cov_coeff=self.cov_coeff,
+        )
+        return loss, parts

@@ -40,6 +40,21 @@ class TrainState(struct.PyTreeNode):
     # probe-off consumers (warm start, serving) never see it.
     probe_params: Any = None
     probe_opt_state: Any = None
+    # SSL-recipe slots (--recipe, simclr_pytorch_distributed_tpu/recipes/):
+    # ``recipe_params`` holds a recipe's extra TRAINABLE tree (the BYOL/
+    # SimSiam predictor head) updated by its own optimizer chain
+    # (``recipe_opt_state``) inside the same compiled step, and
+    # ``recipe_state`` holds non-trainable recipe state transitioned
+    # post-step (the BYOL EMA target network, the MoCo-style negative-queue
+    # ring). All ``None`` for the contrastive recipes without a queue — the
+    # state tree, checkpoint layout, and jit cache keys are then exactly the
+    # pre-recipe ones (the probe-slot contract). When present the triple is
+    # checkpointed as its own ``recipe`` payload (utils/checkpoint.py), so
+    # cross-recipe resumes degrade loudly to fresh recipe-slot init instead
+    # of restoring a mismatched tree.
+    recipe_params: Any = None
+    recipe_opt_state: Any = None
+    recipe_state: Any = None
 
 
 def make_optimizer(

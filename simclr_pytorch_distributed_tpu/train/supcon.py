@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from simclr_pytorch_distributed_tpu import config as config_lib
+from simclr_pytorch_distributed_tpu import recipes as recipes_lib
 from simclr_pytorch_distributed_tpu.data.cifar import (
     ensure_dataset_available,
     load_dataset,
@@ -110,7 +111,8 @@ def make_augment_config(cfg: config_lib.SupConConfig, color_ops: bool = True) ->
 
 
 def resolve_loss_impl(
-    loss_impl: str, batch_size: int, n_devices: int, model_parallel: int = 1
+    loss_impl: str, batch_size: int, n_devices: int, model_parallel: int = 1,
+    moco_queue: int = 0,
 ) -> str:
     """'auto' -> the fused Pallas kernel on TPU, dense otherwise.
 
@@ -121,7 +123,14 @@ def resolve_loss_impl(
     silently downgrades to the O((2B)^2)-materializing dense path on the
     v5e-8 target. Shapes the kernels can't tile fall back to dense, which
     GSPMD partitions as plain HLO.
+
+    ``moco_queue > 0`` forces dense: the queue extends the contrast side to
+    ``2B + K``, which the fixed-geometry fused/ring kernels don't tile
+    (explicit fused/ring with a queue is rejected at parse,
+    config.validate_recipe).
     """
+    if moco_queue and loss_impl == "auto":
+        return "dense"
     if loss_impl != "auto":
         return loss_impl
     if jax.default_backend() != "tpu":
@@ -178,7 +187,8 @@ def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1
         norm_momentum=cfg.norm_momentum, epochs=cfg.epochs,
         steps_per_epoch=steps_per_epoch, grad_div=float(grad_div),
         loss_impl=resolve_loss_impl(
-            cfg.loss_impl, cfg.batch_size, n_devices, cfg.model_parallel
+            cfg.loss_impl, cfg.batch_size, n_devices, cfg.model_parallel,
+            moco_queue=cfg.moco_queue,
         ),
         health=cfg.health_freq > 0,
         health_freq=max(1, cfg.health_freq),
@@ -204,6 +214,7 @@ def attach_online_probe(cfg: config_lib.SupConConfig, state, n_cls: int):
 def make_fused_update(
     model, tx, schedule, step_cfg, aug_cfg, mesh, state_example,
     metric_ring=None, resident=False, window_batches=None, probe=None,
+    recipe=None,
 ):
     """augment(two crops) + train step as one GSPMD program.
 
@@ -236,9 +247,14 @@ def make_fused_update(
     ``probe`` (an OnlineProbe, required iff ``step_cfg.online_probe``) adds
     the detached online-probe update to the same compiled program
     (train/supcon_step.py) — its metrics ride the ring like everything else.
+
+    ``recipe`` (a recipes/ Recipe) swaps the loss head inside the same
+    compiled program — predictor update, EMA transition, and queue rotation
+    all ride the one dispatch (train/supcon_step.make_train_step). ``None``
+    keeps the pre-recipe inline contrastive step.
     """
     train_step = make_train_step(
-        model, tx, schedule, step_cfg, mesh=mesh, probe=probe
+        model, tx, schedule, step_cfg, mesh=mesh, probe=probe, recipe=recipe
     )
     repl = replicated_sharding(mesh)
     state_sh = state_sharding(mesh, state_example)
@@ -288,10 +304,13 @@ TB_ITER_SCALARS = (  # reference per-iter scalars, main_supcon.py:327-333
 # training-health TB tags (docs/OBSERVABILITY.md "Training health"): the
 # ring's health/probe columns, logged at the TRUE global step like info/*
 # so a collapse correlates directly against the loss curves. NaN sentinel
-# rows (non-health steps) are skipped host-side.
+# rows (non-health steps) are skipped host-side. Recipe metric columns
+# (recipes/: the VICReg term breakdown) land under recipe/* — the static
+# map covers every recipe's keys; runs without them simply never match.
 EXTRA_TB_TAGS = {
     **{k: "health/" + k[len("health_"):] for k in HEALTH_METRIC_KEYS},
     **{k: "probe/" + k[len("probe_"):] for k in ONLINE_PROBE_METRIC_KEYS},
+    **{k: "recipe/" + k for k in recipes_lib.ALL_RECIPE_METRIC_KEYS},
 }
 
 
@@ -351,7 +370,9 @@ def train_one_epoch(
         telemetry = TelemetrySession(
             cfg.print_freq,
             metric_keys(health=cfg.health_freq > 0,
-                        online_probe=cfg.online_probe == "on"),
+                        online_probe=cfg.online_probe == "on",
+                        extra=recipes_lib.recipe_metric_keys(
+                            getattr(cfg, "recipe", "simclr"))),
             cfg.telemetry,
         )
     batch_time, data_time, losses = AverageMeter(), AverageMeter(), AverageMeter()
@@ -551,6 +572,18 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
     )
     model, schedule, tx, state, step_cfg = build(cfg, steps_per_epoch, mesh.size)
     logging.info("contrastive loss impl: %s", step_cfg.loss_impl)
+    # --recipe: the SSL loss head + its TrainState slots (recipes/). Attach
+    # BEFORE any resume restore so the abstract state carries the recipe
+    # slots (the probe convention below); slot-free recipes leave the state
+    # untouched. The recorded run_recipe event is what offline readers
+    # (scripts/health_report.py) key their per-recipe thresholds on.
+    state, recipe = recipes_lib.attach_for_config(
+        cfg, model, state, schedule=schedule
+    )
+    logging.info(
+        "recipe: %s%s", recipe.name,
+        f" (moco_queue={cfg.moco_queue})" if cfg.moco_queue else "",
+    )
     probe = None
     if cfg.online_probe == "on":
         # attach BEFORE any resume restore: the abstract state then carries
@@ -581,8 +614,14 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         # mesh= makes the restore ELASTIC: orbax reshards onto THIS run's
         # mesh on load, so a checkpoint saved under a different device
         # count resumes here (the supervisor's restart-resized decision;
-        # _warn_mesh_change names the BN/ngpu consequences)
-        state, meta = restore_checkpoint(resume_path, state, mesh=mesh)
+        # _warn_mesh_change names the BN/ngpu consequences). recipe= is the
+        # cross-recipe hygiene key: a checkpoint whose recorded recipe
+        # differs restores the encoder trajectory but degrades the recipe
+        # slots to fresh init, loudly (utils/checkpoint.py).
+        state, meta = restore_checkpoint(
+            resume_path, state, mesh=mesh, recipe=recipe.name,
+            moco_queue=cfg.moco_queue,
+        )
         # mid-epoch emergency save (utils/preempt.py): re-enter the epoch at
         # the first unconsumed batch of its deterministic permutation
         start_epoch, start_step = resume_position(meta, steps_per_epoch)
@@ -606,9 +645,17 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
     # watchdog/gauges ride its flush boundaries.
     telemetry = TelemetrySession(
         cfg.print_freq,
-        metric_keys(health=step_cfg.health, online_probe=step_cfg.online_probe),
+        metric_keys(health=step_cfg.health, online_probe=step_cfg.online_probe,
+                    extra=recipe.metric_keys),
         cfg.telemetry,
         watchdog=obs.watchdog, gauges=obs.gauges,
+    )
+    # durable recipe marker on the recorder stream: offline readers
+    # (scripts/health_report.py) pick their per-recipe collapse signatures
+    # off this event instead of guessing from the metric columns
+    tracing.event(
+        "run_recipe", track="main:guard", recipe=recipe.name,
+        moco_queue=cfg.moco_queue,
     )
 
     def build_update(lr_scale: float):
@@ -618,7 +665,7 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         store_kwargs = dict(
             resident=store is not None,
             window_batches=None if store is None else store.window_batches,
-            probe=probe,
+            probe=probe, recipe=recipe,
         )
         if lr_scale == 1.0:
             return make_fused_update(
@@ -654,7 +701,11 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         )
 
     def policy_meta():
-        return {"lr_scale": policy.lr_scale, "rollbacks": policy.rollbacks}
+        # the recipe name/queue geometry ride checkpoint meta so a resume
+        # under a DIFFERENT recipe is detectable (utils/checkpoint.py
+        # cross-recipe hygiene) without probing payload tree structure
+        return {"lr_scale": policy.lr_scale, "rollbacks": policy.rollbacks,
+                "recipe": recipe.name, "moco_queue": cfg.moco_queue}
 
     update_fn = build_update(policy.lr_scale)
     tb = TBLogger(cfg.tb_folder, enabled=is_main_process())

@@ -43,7 +43,10 @@ from simclr_pytorch_distributed_tpu.ops.losses import (
     cross_entropy_loss,
     supcon_loss,
 )
-from simclr_pytorch_distributed_tpu.ops.metrics import topk_correct
+from simclr_pytorch_distributed_tpu.ops.metrics import (
+    embedding_covariance,
+    topk_correct,
+)
 from simclr_pytorch_distributed_tpu.ops.pallas_loss import (
     fused_sharded_supcon_loss,
     fused_supcon_loss,
@@ -92,12 +95,15 @@ HEALTH_METRIC_KEYS = (
 ONLINE_PROBE_METRIC_KEYS = ("probe_loss", "probe_top1")
 
 
-def metric_keys(health: bool = False, online_probe: bool = False):
+def metric_keys(health: bool = False, online_probe: bool = False, extra=()):
     """The run's full sorted ring-key tuple. The drivers and the step builder
     both call this with the SAME config bits, so a flag mismatch between the
     writer and the TelemetrySession reader fails loudly at trace time
-    (MetricRing.write's key check) instead of silently shifting columns."""
-    keys = METRIC_KEYS
+    (MetricRing.write's key check) instead of silently shifting columns.
+    ``extra`` is the active recipe's own metric-key tuple
+    (``recipe.metric_keys``, e.g. the VICReg term breakdown) — same
+    derivation on both sides, same loud-failure contract."""
+    keys = METRIC_KEYS + tuple(extra)
     if health:
         keys = keys + HEALTH_METRIC_KEYS
     if online_probe:
@@ -162,7 +168,9 @@ def contrastive_health_metrics(emb: jax.Array, grads) -> dict:
         / (n * (n - 1))
     )
     # effective rank = exp(entropy) of the normalized covariance spectrum
-    cov = emb.T @ emb / n
+    # (uncentered second moment — ops/metrics.embedding_covariance, the
+    # construction the VICReg covariance penalty shares in centered form)
+    cov = embedding_covariance(emb)
     eig = jnp.clip(jnp.linalg.eigvalsh(cov), 0.0, None)
     p = eig / jnp.maximum(jnp.sum(eig), 1e-12)
     entropy = -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.maximum(p, 1e-12)), 0.0))
@@ -207,6 +215,28 @@ def build_online_probe(model_name: str, feat_dim: int, n_cls: int,
         jax.random.key(seed), jnp.zeros((2, feat_dim))
     )["params"]
     return OnlineProbe(classifier=classifier, tx=tx), params, tx.init(params)
+
+
+@dataclasses.dataclass(frozen=True)
+class RecipeContext:
+    """Everything one train step hands a recipe's ``loss`` (recipes/base.py):
+    the step's OWN forward products — no recipe re-runs the backbone for the
+    online branch — plus the recipe slots. ``feats`` is the unnormalized
+    fp32 projection matrix ``[2B, D]`` in the view-major row layout
+    (``[v1 of all samples; v2 of all samples]``), ``n_fea`` its L2-normalized
+    form (the contrastive/health layout). ``model``/``params``/
+    ``batch_stats``/``images`` are for recipes that need a SECOND forward
+    through different weights (the BYOL EMA target network)."""
+
+    model: Any
+    params: Any
+    batch_stats: Any
+    images: jax.Array
+    labels: jax.Array
+    feats: jax.Array
+    n_fea: jax.Array
+    recipe_params: Any
+    recipe_state: Any
 
 
 @dataclasses.dataclass(frozen=True)
@@ -279,6 +309,87 @@ def two_view_forward(
     return feats, batch_stats
 
 
+def contrastive_loss_terms(
+    cfg: SupConStepConfig, mesh, fused_on_mesh: bool, n_fea: jax.Array, labels
+):
+    """The contrastive loss term over the normalized view-major ``[2B, D]``
+    embedding rows — the pre-recipe step's loss head, extracted VERBATIM so
+    the inline (``recipe=None``) control path and the supcon/simclr recipe
+    (recipes/supcon.py) share one implementation; the recipe dispatch around
+    it is proven bitwise-neutral driver-level (tests/test_recipes.py,
+    docs/PARITY.md). ``labels`` is the SupCon label vector or ``None`` for
+    SimCLR (the caller resolves ``cfg.method``)."""
+    B = n_fea.shape[0] // 2
+    # stack views back to [B_global, 2, D] with f1 = all view-1 rows
+    # (main_supcon.py:285-286)
+    n_features = jnp.stack([n_fea[:B], n_fea[B:]], axis=1)
+    loss_labels = labels
+    if cfg.loss_impl in ("fused", "ring") and cfg.contrast_mode != "all":
+        raise ValueError(
+            f"loss_impl={cfg.loss_impl!r} implements contrast_mode='all' "
+            f"only; got {cfg.contrast_mode!r} — use loss_impl='dense'"
+        )
+    if cfg.loss_impl == "ring":
+        # anchors stay sharded over 'data'; n_fea is already the view-major
+        # global row layout the ring expects ([v1 rows; v2 rows]).
+        def _ring(rows, lab):
+            return ring_supcon_loss(
+                rows, lab, axis_name=DATA_AXIS,
+                temperature=cfg.temperature,
+                base_temperature=cfg.base_temperature, n_views=2,
+            )
+
+        if loss_labels is None:
+            contrastive = shard_map(
+                lambda r: _ring(r, None),
+                mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(),
+            )(n_fea)
+        else:
+            contrastive = shard_map(
+                _ring, mesh=mesh,
+                in_specs=(P(DATA_AXIS), P()), out_specs=P(),
+            )(n_fea, loss_labels)
+    elif fused_on_mesh:
+        # same row layout and shard_map plumbing as the ring path; the
+        # kernel needs check_vma=False (interpret-mode Pallas cannot type
+        # kernel-internal constants) — its custom VJP compensates for the
+        # per-shard cotangent shares (ops/pallas_loss.py).
+        def _fs(rows, lab):
+            return fused_sharded_supcon_loss(
+                rows, lab, axis_name=DATA_AXIS,
+                temperature=cfg.temperature,
+                base_temperature=cfg.base_temperature, n_views=2,
+                interpret=jax.default_backend() != "tpu",
+            )
+
+        if loss_labels is None:
+            contrastive = shard_map(
+                lambda r: _fs(r, None), mesh=mesh,
+                in_specs=P(DATA_AXIS), out_specs=P(), check_vma=False,
+            )(n_fea)
+        else:
+            contrastive = shard_map(
+                _fs, mesh=mesh,
+                in_specs=(P(DATA_AXIS), P()), out_specs=P(),
+                check_vma=False,
+            )(n_fea, loss_labels)
+    elif cfg.loss_impl == "fused":
+        contrastive = fused_supcon_loss(
+            n_features, labels=loss_labels,
+            temperature=cfg.temperature, base_temperature=cfg.base_temperature,
+            # Mosaic compiles only on TPU; anywhere else (CPU tests) the
+            # kernel runs under the Pallas interpreter.
+            interpret=jax.default_backend() != "tpu",
+        )
+    else:
+        contrastive = supcon_loss(
+            n_features, labels=loss_labels,
+            temperature=cfg.temperature, base_temperature=cfg.base_temperature,
+            contrast_mode=cfg.contrast_mode,
+        )
+    return contrastive
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -286,6 +397,7 @@ def make_train_step(
     cfg: SupConStepConfig,
     mesh=None,
     probe: Optional[OnlineProbe] = None,
+    recipe=None,
 ) -> Callable:
     """Build the pure train step: (state, images[B,2,H,W,C], labels[B]) -> (state, metrics).
 
@@ -298,6 +410,16 @@ def make_train_step(
     encoder/head/optimizer math is bit-identical probe-on vs probe-off
     (tests/test_health.py proves it bitwise) and the probe costs one
     ``[2B, feat_dim] x [feat_dim, n_cls]`` matmul pair per step.
+
+    ``recipe`` (a recipes/ Recipe) swaps the loss head and its extra slots:
+    the recipe's ``loss`` runs inside this same jitted update on the step's
+    own forward (``RecipeContext``), a trainable recipe's predictor rides
+    ``state.recipe_params`` under its own optimizer chain, and its post-step
+    transition (BYOL EMA, queue rotation) lands in ``state.recipe_state`` —
+    all in ONE compiled program, so every recipe inherits the dispatch-only
+    hot loop. ``None`` keeps the pre-recipe inline contrastive step (bench,
+    the dryrun modes, and the bitwise-neutrality control arm — the
+    contrastive term itself is shared via :func:`contrastive_loss_terms`).
     """
     if cfg.loss_impl == "ring" and mesh is None:
         raise ValueError("loss_impl='ring' needs the mesh passed to make_train_step")
@@ -307,7 +429,11 @@ def make_train_step(
             f"{'missing' if probe is None else 'given'} — the step config "
             "and the OnlineProbe must be built together"
         )
-    expected_keys = metric_keys(health=cfg.health, online_probe=cfg.online_probe)
+    recipe_extra = () if recipe is None else tuple(recipe.metric_keys)
+    recipe_trainable = recipe is not None and recipe.trainable
+    expected_keys = metric_keys(
+        health=cfg.health, online_probe=cfg.online_probe, extra=recipe_extra
+    )
     if cfg.health and cfg.health_freq < 1:
         raise ValueError(f"health_freq must be >= 1, got {cfg.health_freq}")
     # 'fused' on a multi-device mesh routes through the shard_map-sharded
@@ -319,7 +445,7 @@ def make_train_step(
         cfg.loss_impl == "fused" and mesh is not None and mesh.size > 1
     )
 
-    def loss_fn(params, state: TrainState, images, labels):
+    def loss_fn(params, recipe_params, state: TrainState, images, labels):
         probe_feats = None
         if probe is not None:
             (feats, enc_feats), new_batch_stats = two_view_forward(
@@ -334,7 +460,6 @@ def make_train_step(
                 model, params, state.batch_stats, images, train=True
             )
         feats = feats.astype(jnp.float32)
-        B = images.shape[0]
 
         # feature-norm statistics on UNNORMALIZED embeddings (main_supcon.py:298-301)
         norms = jnp.linalg.norm(feats, axis=1)
@@ -353,77 +478,27 @@ def make_train_step(
         loss_sec = jnp.mean(jnp.square(norms - record))
         loss_l2reg = jnp.mean(jnp.square(norms))
 
-        # normalize AFTER the (logical) gather (main_supcon.py:283), stack views
-        # back to [B_global, 2, D] with f1 = all view-1 rows (:285-286)
+        # normalize AFTER the (logical) gather (main_supcon.py:283)
         n_fea = feats / jnp.linalg.norm(feats, axis=1, keepdims=True)
-        n_features = jnp.stack([n_fea[:B], n_fea[B:]], axis=1)
 
-        if cfg.method not in ("SupCon", "SimCLR"):
-            raise ValueError(f"contrastive method not supported: {cfg.method}")
-        loss_labels = labels if cfg.method == "SupCon" else None
-        if cfg.loss_impl in ("fused", "ring") and cfg.contrast_mode != "all":
-            raise ValueError(
-                f"loss_impl={cfg.loss_impl!r} implements contrast_mode='all' "
-                f"only; got {cfg.contrast_mode!r} — use loss_impl='dense'"
-            )
-        if cfg.loss_impl == "ring":
-            # anchors stay sharded over 'data'; n_fea is already the view-major
-            # global row layout the ring expects ([v1 rows; v2 rows]).
-            def _ring(rows, lab):
-                return ring_supcon_loss(
-                    rows, lab, axis_name=DATA_AXIS,
-                    temperature=cfg.temperature,
-                    base_temperature=cfg.base_temperature, n_views=2,
+        recipe_aux = {}
+        if recipe is None:
+            # the pre-recipe inline path (bitwise control arm; bench/dryruns)
+            if cfg.method not in ("SupCon", "SimCLR"):
+                raise ValueError(
+                    f"contrastive method not supported: {cfg.method}"
                 )
-
-            if loss_labels is None:
-                contrastive = shard_map(
-                    lambda r: _ring(r, None),
-                    mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(),
-                )(n_fea)
-            else:
-                contrastive = shard_map(
-                    _ring, mesh=mesh,
-                    in_specs=(P(DATA_AXIS), P()), out_specs=P(),
-                )(n_fea, loss_labels)
-        elif fused_on_mesh:
-            # same row layout and shard_map plumbing as the ring path; the
-            # kernel needs check_vma=False (interpret-mode Pallas cannot type
-            # kernel-internal constants) — its custom VJP compensates for the
-            # per-shard cotangent shares (ops/pallas_loss.py).
-            def _fs(rows, lab):
-                return fused_sharded_supcon_loss(
-                    rows, lab, axis_name=DATA_AXIS,
-                    temperature=cfg.temperature,
-                    base_temperature=cfg.base_temperature, n_views=2,
-                    interpret=jax.default_backend() != "tpu",
-                )
-
-            if loss_labels is None:
-                contrastive = shard_map(
-                    lambda r: _fs(r, None), mesh=mesh,
-                    in_specs=P(DATA_AXIS), out_specs=P(), check_vma=False,
-                )(n_fea)
-            else:
-                contrastive = shard_map(
-                    _fs, mesh=mesh,
-                    in_specs=(P(DATA_AXIS), P()), out_specs=P(),
-                    check_vma=False,
-                )(n_fea, loss_labels)
-        elif cfg.loss_impl == "fused":
-            contrastive = fused_supcon_loss(
-                n_features, labels=loss_labels,
-                temperature=cfg.temperature, base_temperature=cfg.base_temperature,
-                # Mosaic compiles only on TPU; anywhere else (CPU tests) the
-                # kernel runs under the Pallas interpreter.
-                interpret=jax.default_backend() != "tpu",
+            loss_labels = labels if cfg.method == "SupCon" else None
+            contrastive = contrastive_loss_terms(
+                cfg, mesh, fused_on_mesh, n_fea, loss_labels
             )
         else:
-            contrastive = supcon_loss(
-                n_features, labels=loss_labels,
-                temperature=cfg.temperature, base_temperature=cfg.base_temperature,
-                contrast_mode=cfg.contrast_mode,
+            ctx = RecipeContext(
+                model=model, params=params, batch_stats=state.batch_stats,
+                images=images, labels=labels, feats=feats, n_fea=n_fea,
+                recipe_params=recipe_params, recipe_state=state.recipe_state,
             )
+            contrastive, recipe_aux = recipe.loss(cfg, mesh, fused_on_mesh, ctx)
 
         # linear-ramped aux terms (main_supcon.py:311-317)
         ramp = state.step / (cfg.epochs * cfg.steps_per_epoch)
@@ -441,6 +516,9 @@ def make_train_step(
             "loss_sec": loss_sec,
             "loss_l2reg": loss_l2reg,
         }
+        # recipe extras: metric terms (recipe.metric_keys) + the detached
+        # rotation payload ("recipe_embeddings", queue recipes)
+        aux.update(recipe_aux)
         if cfg.health:
             # the loss's OWN normalized, view-major embedding rows — the
             # health diagnostics' input, detached so aux plumbing cannot
@@ -477,15 +555,43 @@ def make_train_step(
     def train_step(
         state: TrainState, images: jax.Array, labels: jax.Array
     ) -> Tuple[TrainState, dict]:
-        grads, (aux, new_batch_stats) = jax.grad(loss_fn, has_aux=True)(
-            state.params, state, images, labels
-        )
+        if recipe_trainable:
+            # joint gradient: the recipe's predictor trains WITH the encoder
+            # (BYOL/SimSiam gradients reach the backbone only through the
+            # predictor path), each under its own optimizer chain
+            (grads, rgrads), (aux, new_batch_stats) = jax.grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(state.params, state.recipe_params, state, images, labels)
+        else:
+            grads, (aux, new_batch_stats) = jax.grad(loss_fn, has_aux=True)(
+                state.params,
+                None if recipe is None else state.recipe_params,
+                state, images, labels,
+            )
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = dict(aux, learning_rate=jnp.asarray(schedule(state.step)))
         metrics.pop("embeddings", None)
         metrics.pop("probe_feats", None)
+        metrics.pop("recipe_embeddings", None)
         replace_kwargs = {}
+        if recipe_trainable:
+            rupdates, new_ropt = recipe.tx.update(
+                rgrads, state.recipe_opt_state, state.recipe_params
+            )
+            replace_kwargs.update(
+                recipe_params=optax.apply_updates(
+                    state.recipe_params, rupdates
+                ),
+                recipe_opt_state=new_ropt,
+            )
+        if recipe is not None and state.recipe_state is not None:
+            # the recipe's post-step state transition (BYOL EMA toward the
+            # freshly updated online params; queue rotation with the batch's
+            # detached embeddings) — still inside this one compiled program
+            replace_kwargs["recipe_state"] = recipe.post_step(
+                state.recipe_state, new_params=new_params, aux=aux
+            )
         if cfg.health:
             # lax.cond, not where: the false branch must SKIP the O((2B)^2)
             # similarity matmul and the d x d eigendecomposition at runtime,
@@ -529,6 +635,7 @@ def make_sharded_train_step(
     mesh,
     state_shape: Optional[Any] = None,
     donate: bool = True,
+    recipe=None,
 ) -> Callable:
     """jit the train step over the mesh: state replicated, batch data-sharded.
 
@@ -536,7 +643,7 @@ def make_sharded_train_step(
     feature all-gather for the loss matmul and a gradient reduce over ICI —
     the TPU-native replacement for NCCL all_gather + DDP bucketed all-reduce.
     """
-    step = make_train_step(model, tx, schedule, cfg, mesh=mesh)
+    step = make_train_step(model, tx, schedule, cfg, mesh=mesh, recipe=recipe)
     repl = replicated_sharding(mesh)
 
     state_sh = (

@@ -258,6 +258,18 @@ def save_checkpoint(
                 },
                 block=block,
             ))
+        recipe_payload = _recipe_payload(state)
+        if recipe_payload:
+            # SSL-recipe slots (recipes/, the probe-payload convention): the
+            # predictor/EMA-target/queue trees live in their own payload so
+            # model/train consumers never see them and a cross-recipe resume
+            # can skip them cleanly. Which slots exist varies by recipe
+            # (SimSiam has no recipe_state, the queue has only it), so only
+            # the non-None slots are written — restore mirrors this from the
+            # abstract state.
+            ckptrs.append(_save_tree(
+                os.path.join(path, "recipe"), recipe_payload, block=block,
+            ))
         meta = {
             **(extra_meta or {}),
             "epoch": epoch, "step_in_epoch": int(step_in_epoch),
@@ -276,6 +288,85 @@ def save_checkpoint(
         else:
             _PENDING.append((ckptrs, path, meta))
     return path
+
+
+RECIPE_SLOTS = ("recipe_params", "recipe_opt_state", "recipe_state")
+
+
+def _recipe_payload(state) -> dict:
+    """The non-None recipe slots of a state, as the ``recipe`` payload dict
+    (empty when the recipe contributes no slots)."""
+    return {
+        slot: value for slot in RECIPE_SLOTS
+        if (value := getattr(state, slot, None)) is not None
+    }
+
+
+def _restore_recipe_slots(path: str, state, abstract_state, meta: dict,
+                          recipe: "str | None", mesh=None,
+                          moco_queue: "int | None" = None):
+    """Cross-recipe checkpoint hygiene (the probe-payload convention, made
+    generic): restore the ``recipe`` payload ONLY when the checkpoint's
+    recorded recipe matches this run's, else degrade LOUDLY to the fresh
+    recipe-slot init the abstract state already carries.
+
+    Matching is by the ``recipe`` name and ``moco_queue`` geometry stamped
+    into meta.json by the driver — not by tree structure, which can
+    coincide across recipes and silently restore a mismatched tree. A
+    structural mismatch inside a matching name (hand-edited meta, changed
+    predictor geometry) still degrades rather than failing the whole
+    restore.
+    """
+    import logging
+
+    wanted = _recipe_payload(abstract_state)
+    saved_recipe = meta.get("recipe")
+    if not wanted:
+        if os.path.isdir(os.path.join(path, "recipe")):
+            # e.g. a BYOL checkpoint resumed with --recipe supcon: the
+            # encoder trajectory restores, the predictor/target are dropped
+            logging.warning(
+                "checkpoint %s carries a %r recipe payload this run's "
+                "recipe (%r) does not use; recipe slots ignored",
+                path, saved_recipe, recipe,
+            )
+        return state
+    if not os.path.isdir(os.path.join(path, "recipe")):
+        logging.warning(
+            "checkpoint %s has no recipe payload (saved recipe %r, this "
+            "run %r); recipe slots start fresh", path, saved_recipe, recipe,
+        )
+        return state
+    if recipe is not None and saved_recipe is not None and saved_recipe != recipe:
+        logging.warning(
+            "checkpoint %s was trained with recipe %r but this run uses "
+            "%r; the encoder trajectory is restored, recipe slots "
+            "(predictor/EMA target/queue) start fresh", path, saved_recipe,
+            recipe,
+        )
+        return state
+    saved_queue = meta.get("moco_queue")
+    if (moco_queue is not None and saved_queue is not None
+            and int(saved_queue) != int(moco_queue)):
+        logging.warning(
+            "checkpoint %s was trained with --moco_queue %s but this run "
+            "uses %s; the queue/key-encoder slots start fresh (the ring "
+            "geometry changed)", path, saved_queue, moco_queue,
+        )
+        return state
+    try:
+        restored = _restore_tree(
+            os.path.join(path, "recipe"),
+            _abstract(wanted, mesh),
+        )
+    except Exception as e:  # orbax raises various types on tree mismatch
+        logging.warning(
+            "checkpoint %s recipe payload does not match this run's "
+            "recipe-slot structure (%s); recipe slots start fresh",
+            path, e,
+        )
+        return state
+    return state.replace(**restored)
 
 
 def resolve_resume_path(path: str) -> str:
@@ -334,9 +425,18 @@ def resolve_resume_path(path: str) -> str:
     return max(candidates)[3]
 
 
-def restore_checkpoint(path: str, abstract_state, mesh=None) -> Tuple[Any, dict]:
+def restore_checkpoint(
+    path: str, abstract_state, mesh=None, recipe: "str | None" = None,
+    moco_queue: "int | None" = None,
+) -> Tuple[Any, dict]:
     """Full-state resume. ``abstract_state`` is a freshly built TrainState with
     the right structure (its values are only used as shape/dtype targets).
+
+    ``recipe`` (the run's resolved ``--recipe`` name) and ``moco_queue``
+    gate the ``recipe`` payload: it restores only when both match the
+    checkpoint's recorded values — a cross-recipe (or changed-queue-
+    geometry) resume keeps the encoder trajectory and loudly re-initializes
+    the recipe slots (``_restore_recipe_slots``).
 
     MESH-SHAPE-AGNOSTIC: ``mesh`` (the run's current mesh) makes the restore
     elastic — orbax reshards every leaf onto the current mesh's layout on
@@ -392,13 +492,6 @@ def restore_checkpoint(path: str, abstract_state, mesh=None) -> Tuple[Any, dict]
                 "checkpoint %s has no online-probe payload; the probe "
                 "restarts from its fresh init", path,
             )
-    # Re-own every restored buffer through the shared jitted copy: orbax
-    # hands back arrays whose host memory the XLA allocator does not own,
-    # and the train steps DONATE their input state — donating a
-    # not-XLA-owned buffer double-frees and corrupts the heap (segfault
-    # within two steps of any resume on the CPU backend; found by
-    # tests/test_fault_injection.py).
-    state = jit_copy_tree(state)
     meta_path = os.path.join(path, META_FILE)
     if not os.path.exists(meta_path):
         # meta.json is stamped only after the payload writes commit; its
@@ -412,6 +505,19 @@ def restore_checkpoint(path: str, abstract_state, mesh=None) -> Tuple[Any, dict]
         )
     with open(meta_path) as f:
         meta = json.load(f)
+    # recipe slots AFTER the meta read: which payload (if any) restores is
+    # decided by the recipe name recorded there, not by tree structure
+    state = _restore_recipe_slots(
+        path, state, abstract_state, meta, recipe, mesh=mesh,
+        moco_queue=moco_queue,
+    )
+    # Re-own every restored buffer through the shared jitted copy: orbax
+    # hands back arrays whose host memory the XLA allocator does not own,
+    # and the train steps DONATE their input state — donating a
+    # not-XLA-owned buffer double-frees and corrupts the heap (segfault
+    # within two steps of any resume on the CPU backend; found by
+    # tests/test_fault_injection.py).
+    state = jit_copy_tree(state)
     _warn_layout_mismatch(path, meta)
     _warn_mesh_change(path, meta)
     return state, meta

@@ -206,6 +206,35 @@ class HealthThresholds:
     min_samples: int = 2
 
 
+# Per-recipe detector bars (recipes/, docs/OBSERVABILITY.md threshold
+# table). The contrastive recipes keep the PR-8 defaults: their loss
+# actively repels negatives, so only the fully degenerate regime should
+# fire. The negative-FREE recipes (BYOL/SimSiam) are exactly the runs where
+# collapse is the failure mode the recipe's asymmetry exists to prevent —
+# here the detector is load-bearing, not decorative, so the effective-rank
+# bar is raised: an ablated predictor (the known-collapsing form,
+# recipes/byol.py) must trip it. Healthy negative-free runs legitimately
+# drive alignment toward 1, so the align bar stays paired with neg_mean
+# (both ~1 = constant embeddings) rather than tightened. VICReg's variance
+# hinge fights collapse in the loss itself — defaults apply, and an alarm
+# there means the coefficients are broken.
+RECIPE_HEALTH_THRESHOLDS = {
+    "supcon": HealthThresholds(),
+    "simclr": HealthThresholds(),
+    "byol": HealthThresholds(eff_rank_min=3.0),
+    "simsiam": HealthThresholds(eff_rank_min=3.0),
+    "vicreg": HealthThresholds(),
+}
+
+
+def thresholds_for_recipe(recipe: "str | None") -> HealthThresholds:
+    """The live detector bars for a recipe name; unknown/None (the probe/CE
+    trainers, pre-recipe event streams) get the defaults. Shared by the
+    in-run HealthMonitor (utils/obs.py) and the offline reader
+    (scripts/health_report.py), so both reach the same verdict."""
+    return RECIPE_HEALTH_THRESHOLDS.get(recipe, HealthThresholds())
+
+
 class HealthMonitor:
     """Windowed collapse/divergence detector over the ring's health samples.
 
@@ -225,11 +254,15 @@ class HealthMonitor:
     """
 
     def __init__(self, policy: str = "warn", thresholds: HealthThresholds = None,
-                 window: int = HEALTH_WINDOW):
+                 window: int = HEALTH_WINDOW, extra_keys=()):
         if policy not in ("warn", "abort"):
             raise ValueError(f"unknown health_policy {policy!r}")
         self.policy = policy
         self.thresholds = thresholds if thresholds is not None else HealthThresholds()
+        # recipe metric columns (recipes/*.metric_keys, e.g. the VICReg term
+        # breakdown) ingested alongside the health_/probe_ families so they
+        # ride the same window means -> health_window events -> gauges
+        self.extra_keys = tuple(extra_keys)
         self._window: "deque[dict]" = deque(maxlen=window)
         self.samples = 0  # real health samples ingested (sentinels excluded)
         self.alarms = 0
@@ -249,7 +282,7 @@ class HealthMonitor:
         (non-sentinel) health sample."""
         sample = {
             k: float(v) for k, v in metrics.items()
-            if k.startswith(("health_", "probe_"))
+            if k.startswith(("health_", "probe_")) or k in self.extra_keys
         }
         health_vals = [v for k, v in sample.items() if k.startswith("health_")]
         if not health_vals or all(math.isnan(v) for v in health_vals):

@@ -61,10 +61,27 @@ class RunObservability:
             )
         self.health = None
         if getattr(cfg, "health_freq", 0) > 0:
-            from simclr_pytorch_distributed_tpu.utils.guard import HealthMonitor
+            from simclr_pytorch_distributed_tpu.utils.guard import (
+                HealthMonitor,
+                thresholds_for_recipe,
+            )
 
+            from simclr_pytorch_distributed_tpu.recipes import (
+                recipe_metric_keys,
+            )
+
+            # per-recipe bars (guard.RECIPE_HEALTH_THRESHOLDS): the
+            # negative-free recipes run under a raised eff-rank bar —
+            # there the collapse detector is load-bearing. The recipe's
+            # own metric columns ride the same window stream.
             self.health = HealthMonitor(
-                policy=getattr(cfg, "health_policy", "warn")
+                policy=getattr(cfg, "health_policy", "warn"),
+                thresholds=thresholds_for_recipe(
+                    getattr(cfg, "recipe", None)
+                ),
+                extra_keys=recipe_metric_keys(
+                    getattr(cfg, "recipe", None)
+                ),
             )
         self.gauges = self.sidecar = None
         if cfg.metrics_port:

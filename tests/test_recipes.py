@@ -1,0 +1,935 @@
+"""The pluggable SSL-recipe subsystem (recipes/, --recipe).
+
+The load-bearing claims, tested mechanically (the test_health conventions):
+
+- REFACTOR NEUTRALITY: ``--recipe supcon`` through the recipe interface
+  produces BITWISE-identical params/BN-stats/optimizer-state to the
+  pre-refactor inline update (``make_fused_update(recipe=None)``) — at step
+  level and through the REAL driver over 2 epochs, under host AND device
+  data placement (the acceptance bar; docs/PARITY.md).
+- EVERY RECIPE RIDES THE SUBSTRATE: one real sync-mode driver epoch per
+  recipe on the host path (the consume-signature smoke), and the PR-4/PR-5
+  zero-sync transfer contract re-proven per recipe on the device path —
+  exactly 3 ring D2H + 1 index upload with health + probe + the recipe on
+  (the device-placement smoke and the mechanical transfer proof in one).
+- COLLAPSE IS CAUGHT PER RECIPE: a degenerate constant-embedding run under
+  each new recipe (BYOL in its predictor-ABLATED form — the configuration
+  whose collapse the detector exists for) trips the typed code-3 abort
+  through the ring->monitor->collective-exchange path.
+- CHECKPOINT HYGIENE: recipe slots live in their own ``recipe`` payload
+  keyed by the meta-recorded recipe name; cross-recipe resumes degrade
+  loudly to fresh slots, same-recipe resumes restore bitwise.
+"""
+
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu import config as config_lib
+from simclr_pytorch_distributed_tpu import recipes as recipes_lib
+from simclr_pytorch_distributed_tpu.models import SupConResNet
+from simclr_pytorch_distributed_tpu.ops.losses import (
+    byol_loss,
+    moco_queue_loss,
+    simsiam_loss,
+    supcon_loss,
+    vicreg_loss,
+)
+from simclr_pytorch_distributed_tpu.ops.metrics import embedding_covariance
+from simclr_pytorch_distributed_tpu.recipes.byol import BYOLRecipe
+from simclr_pytorch_distributed_tpu.train import supcon_step
+from simclr_pytorch_distributed_tpu.train.state import (
+    create_train_state,
+    make_optimizer,
+)
+from simclr_pytorch_distributed_tpu.train.supcon_step import (
+    SupConStepConfig,
+    make_train_step,
+    metric_keys,
+)
+from simclr_pytorch_distributed_tpu.utils.guard import (
+    HealthThresholds,
+    RepresentationHealthError,
+    thresholds_for_recipe,
+)
+
+pytestmark = pytest.mark.recipe
+
+SIZE = 8
+
+
+def assert_trees_bitwise(a, b):
+    fa = jax.tree.leaves(jax.device_get(a))
+    fb = jax.tree.leaves(jax.device_get(b))
+    assert len(fa) == len(fb)
+    for xa, xb in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+# ------------------------------------------------------------ the loss terms
+
+
+def _two_view(rng, b=6, d=10):
+    v1 = rng.normal(size=(b, d)).astype(np.float32)
+    v2 = rng.normal(size=(b, d)).astype(np.float32)
+    return np.concatenate([v1, v2])  # view-major [2B, D]
+
+
+def test_byol_loss_zero_at_alignment_two_when_orthogonal(rng):
+    t = _two_view(rng)
+    b = t.shape[0] // 2
+    # pred row i == normalized target row (i+B)%2B -> exact regression, 0
+    pred = np.concatenate([t[b:], t[:b]])
+    assert float(byol_loss(jnp.asarray(pred), jnp.asarray(t))) == pytest.approx(
+        0.0, abs=1e-6
+    )
+    # orthogonal pred/target -> ||p - t||^2 = 2 per row
+    d = 8
+    e = np.eye(d, dtype=np.float32)
+    pred = np.concatenate([e[:3], e[:3]])
+    targ = np.concatenate([e[3:6], e[3:6]])
+    assert float(byol_loss(jnp.asarray(pred), jnp.asarray(targ))) == pytest.approx(
+        2.0, abs=1e-6
+    )
+
+
+def test_simsiam_loss_bounds_and_alignment(rng):
+    z = _two_view(rng)
+    b = z.shape[0] // 2
+    pred = np.concatenate([z[b:], z[:b]])
+    # pred == cross(proj) -> cos = 1 -> loss -1 (its minimum)
+    assert float(simsiam_loss(jnp.asarray(pred), jnp.asarray(z))) == pytest.approx(
+        -1.0, abs=1e-6
+    )
+    val = float(simsiam_loss(jnp.asarray(z), jnp.asarray(z)))
+    assert -1.0 <= val <= 1.0
+
+
+def test_simsiam_stop_gradient_is_inside_the_loss(rng):
+    """The projection side must be detached IN the loss: grads w.r.t. the
+    proj argument are exactly zero while the pred side's are not."""
+    z = jnp.asarray(_two_view(rng))
+    p = jnp.asarray(_two_view(rng))
+    gp, gz = jax.grad(lambda a, b: simsiam_loss(a, b), argnums=(0, 1))(p, z)
+    assert float(jnp.sum(jnp.abs(gz))) == 0.0
+    assert float(jnp.sum(jnp.abs(gp))) > 0.0
+
+
+def test_vicreg_loss_matches_numpy_reference(rng):
+    b, d = 12, 6
+    z1 = rng.normal(size=(b, d)).astype(np.float32) * 2.0
+    z2 = (z1 + 0.3 * rng.normal(size=(b, d))).astype(np.float32)
+    loss, parts = vicreg_loss(
+        jnp.asarray(z1), jnp.asarray(z2),
+        sim_coeff=25.0, std_coeff=25.0, cov_coeff=1.0,
+    )
+    inv_ref = np.mean((z1 - z2) ** 2)
+    var_ref, cov_ref = 0.0, 0.0
+    for z in (z1, z2):
+        std = np.sqrt(z.var(axis=0) + 1e-4)
+        var_ref += np.mean(np.maximum(0.0, 1.0 - std)) / 2
+        zc = z - z.mean(axis=0)
+        cov = (zc.T @ zc) / (b - 1)
+        cov_ref += np.sum((cov - np.diag(np.diag(cov))) ** 2) / d / 2
+    assert float(parts["vicreg_inv"]) == pytest.approx(inv_ref, rel=1e-4)
+    assert float(parts["vicreg_var"]) == pytest.approx(var_ref, rel=1e-4, abs=1e-6)
+    assert float(parts["vicreg_cov"]) == pytest.approx(cov_ref, rel=1e-3)
+    assert float(loss) == pytest.approx(
+        25 * inv_ref + 25 * var_ref + cov_ref, rel=1e-3
+    )
+    # well-spread embeddings (std > 1): the variance hinge contributes 0
+    z_wide = rng.normal(size=(b, d)).astype(np.float32) * 5.0
+    _, parts_wide = vicreg_loss(jnp.asarray(z_wide), jnp.asarray(z_wide))
+    assert float(parts_wide["vicreg_var"]) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_embedding_covariance_shared_construction(rng):
+    z = rng.normal(size=(10, 4)).astype(np.float32)
+    # uncentered second moment == the health diagnostics' expression
+    np.testing.assert_allclose(
+        np.asarray(embedding_covariance(jnp.asarray(z))), z.T @ z / 10,
+        rtol=1e-6,
+    )
+    zc = z - z.mean(axis=0)
+    np.testing.assert_allclose(
+        np.asarray(embedding_covariance(jnp.asarray(z), center=True, ddof=1)),
+        zc.T @ zc / 9, rtol=1e-5,
+    )
+
+
+def test_moco_queue_loss_matches_numpy_reference(rng):
+    b, d, k = 4, 8, 6
+    query = _two_view(rng, b=b, d=d)
+    query = query / np.linalg.norm(query, axis=1, keepdims=True)
+    key = _two_view(rng, b=b, d=d)
+    key = key / np.linalg.norm(key, axis=1, keepdims=True)
+    queue = rng.normal(size=(k, d)).astype(np.float32)
+    queue = queue / np.linalg.norm(queue, axis=1, keepdims=True)
+    temp, base = 0.5, 0.07
+    n = 2 * b
+    contrast = np.concatenate([key, queue])
+    logits = query @ contrast.T / temp
+    logits -= logits.max(axis=1, keepdims=True)
+    mask = np.ones((n, n + k), np.float32)
+    mask[np.arange(n), np.arange(n)] = 0.0  # own view's key: false negative
+    log_prob = logits - np.log((np.exp(logits) * mask).sum(axis=1, keepdims=True))
+    pos = (np.arange(n) + b) % n
+    ref = -(temp / base) * log_prob[np.arange(n), pos]
+    got = float(moco_queue_loss(
+        jnp.asarray(query), jnp.asarray(key), jnp.asarray(queue),
+        temperature=temp, base_temperature=base,
+    ))
+    assert got == pytest.approx(float(ref.mean()), rel=1e-5)
+
+
+def test_moco_queue_loss_degenerates_to_simclr(rng):
+    """K=0 with key == query must equal the dense SimCLR loss exactly —
+    the MoCo extension is a strict superset of the existing op sequence."""
+    b, d = 4, 8
+    feats = _two_view(rng, b=b, d=d)
+    feats = feats / np.linalg.norm(feats, axis=1, keepdims=True)
+    n_features = jnp.stack([jnp.asarray(feats[:b]), jnp.asarray(feats[b:])], 1)
+    dense = float(supcon_loss(
+        n_features, temperature=0.5, base_temperature=0.07
+    ))
+    queued = float(moco_queue_loss(
+        jnp.asarray(feats), jnp.asarray(feats),
+        jnp.zeros((0, d), jnp.float32),
+        temperature=0.5, base_temperature=0.07,
+    ))
+    assert queued == pytest.approx(dense, rel=1e-6)
+
+
+# --------------------------------------------------- config surface + registry
+
+
+def test_recipe_auto_resolves_from_method():
+    cfg = config_lib.SupConConfig(method="SimCLR")
+    config_lib.validate_recipe(cfg)
+    assert cfg.recipe == "simclr"
+    cfg = config_lib.SupConConfig(method="SupCon")
+    config_lib.validate_recipe(cfg)
+    assert cfg.recipe == "supcon"
+
+
+def test_recipe_forces_method():
+    # supcon forcing is unambiguous (SimCLR == the --method default)
+    cfg = config_lib.SupConConfig(recipe="supcon", method="SimCLR")
+    config_lib.validate_recipe(cfg)
+    assert cfg.method == "SupCon"
+
+
+@pytest.mark.parametrize("over,match", [
+    (dict(recipe="byol", method="SupCon"), "label-free"),
+    # SupCon is not the --method default, so this is an explicit
+    # contradiction — silently dropping the labels would be worse
+    (dict(recipe="simclr", method="SupCon"), "contradicts"),
+    (dict(recipe="supcon", moco_queue=512), "NEGATIVES only"),
+    (dict(recipe="byol", moco_queue=512), "NEGATIVES only"),
+    (dict(recipe="simclr", moco_queue=100, batch_size=64), "multiple of"),
+    (dict(recipe="simclr", moco_queue=512, loss_impl="fused"), "dense"),
+    (dict(recipe="simclr", moco_queue=512, loss_impl="ring"), "dense"),
+    (dict(recipe="byol", ema_momentum=1.0), "ema_momentum"),
+    (dict(recipe="byol", ema_momentum=-0.1), "ema_momentum"),
+    (dict(recipe="vicreg", vicreg_std_coeff=-1.0), "vicreg_std_coeff"),
+])
+def test_validate_recipe_rejects(over, match):
+    cfg = config_lib.SupConConfig(**over)
+    with pytest.raises(ValueError, match=match):
+        config_lib.validate_recipe(cfg)
+
+
+def test_recipe_flags_parse_and_finalize(tmp_path):
+    cfg = config_lib.parse_supcon([
+        "--recipe", "byol", "--ema_momentum", "0.99",
+        "--predictor_hidden", "64", "--workdir", str(tmp_path),
+    ])
+    assert cfg.recipe == "byol" and cfg.ema_momentum == 0.99
+    cfg = config_lib.parse_supcon([
+        "--recipe", "simclr", "--moco_queue", "512",
+        "--workdir", str(tmp_path),
+    ])
+    assert cfg.moco_queue == 512
+
+
+def test_build_recipe_slots_per_recipe():
+    model = SupConResNet(model_name="resnet10", feat_dim=16)
+    tx = make_optimizer(0.1)
+    state = create_train_state(
+        model, tx, jax.random.key(0), jnp.zeros((2, SIZE, SIZE, 3))
+    )
+
+    def attach(**over):
+        cfg = config_lib.SupConConfig(
+            feat_dim=16, predictor_hidden=32, batch_size=4, **over
+        )
+        config_lib.validate_recipe(cfg)
+        return recipes_lib.attach_for_config(cfg, model, state)
+
+    # contrastive, no queue: attach is a strict no-op (same object)
+    s, r = attach(recipe="supcon")
+    assert s is state and r.name == "supcon"
+    s, r = attach(recipe="simclr")
+    assert s is state and r.name == "simclr"
+
+    s, r = attach(recipe="simclr", moco_queue=16)
+    assert s.recipe_params is None and s.recipe_opt_state is None
+    assert s.recipe_state["queue_emb"].shape == (16, 16)
+    norms = jnp.linalg.norm(s.recipe_state["queue_emb"], axis=1)
+    np.testing.assert_allclose(np.asarray(norms), 1.0, rtol=1e-5)
+    # the momentum KEY encoder starts as a copy of the online network
+    assert_trees_bitwise(s.recipe_state["key_params"], state.params)
+
+    s, r = attach(recipe="byol")
+    assert r.trainable and s.recipe_params is not None
+    assert s.recipe_opt_state is not None
+    assert_trees_bitwise(s.recipe_state["target_params"], state.params)
+
+    s, r = attach(recipe="byol", byol_predictor="none")
+    assert not r.trainable and s.recipe_params is None
+    assert s.recipe_state is not None
+
+    s, r = attach(recipe="simsiam")
+    assert r.trainable and s.recipe_params is not None
+    assert s.recipe_state is None
+
+    s, r = attach(recipe="vicreg")
+    assert s is state and r.metric_keys == ("vicreg_cov", "vicreg_inv",
+                                            "vicreg_var")
+
+
+def test_thresholds_for_recipe():
+    assert thresholds_for_recipe("byol").eff_rank_min == 3.0
+    assert thresholds_for_recipe("simsiam").eff_rank_min == 3.0
+    assert thresholds_for_recipe("simclr") == HealthThresholds()
+    assert thresholds_for_recipe("vicreg") == HealthThresholds()
+    assert thresholds_for_recipe(None) == HealthThresholds()
+
+
+def test_resolve_loss_impl_queue_forces_dense(monkeypatch):
+    from simclr_pytorch_distributed_tpu.train.supcon import resolve_loss_impl
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert resolve_loss_impl("auto", 256, 1, moco_queue=512) == "dense"
+    assert resolve_loss_impl("dense", 256, 1, moco_queue=512) == "dense"
+
+
+# ------------------------------------------------------------- step level
+
+
+def _tiny_recipe(recipe_name, n_steps=2, batch=4, **cfg_over):
+    cfg = config_lib.SupConConfig(
+        model="resnet10", feat_dim=16, batch_size=batch, recipe=recipe_name,
+        predictor_hidden=32, learning_rate=0.1, **cfg_over,
+    )
+    config_lib.validate_recipe(cfg)
+    model = SupConResNet(model_name="resnet10", feat_dim=16)
+    tx = make_optimizer(0.1)
+    state = create_train_state(
+        model, tx, jax.random.key(0), jnp.zeros((2, SIZE, SIZE, 3))
+    )
+    state, recipe = recipes_lib.attach_for_config(cfg, model, state)
+    scfg = SupConStepConfig(
+        method=cfg.method, steps_per_epoch=4, loss_impl="dense",
+    )
+    step = jax.jit(make_train_step(model, tx, lambda s: 0.1, scfg,
+                                   recipe=recipe))
+    images = jax.random.uniform(jax.random.key(1), (batch, 2, SIZE, SIZE, 3))
+    labels = jnp.arange(batch) % 2
+    metrics = None
+    for _ in range(n_steps):
+        state, metrics = step(state, images, labels)
+    return state, recipe, jax.device_get(metrics)
+
+
+def test_supcon_recipe_step_bitwise_vs_inline():
+    """Step-level refactor neutrality: the recipe dispatch around the
+    extracted contrastive term changes NOTHING — params, BN stats,
+    optimizer state, and every metric bitwise-equal after 3 steps (the
+    driver-level 2-epoch proof below is the acceptance bar)."""
+    model = SupConResNet(model_name="resnet10", feat_dim=16)
+    tx = make_optimizer(0.1)
+    scfg = SupConStepConfig(method="SupCon", steps_per_epoch=4,
+                            loss_impl="dense")
+    images = jax.random.uniform(jax.random.key(1), (4, 2, SIZE, SIZE, 3))
+    labels = jnp.arange(4) % 2
+
+    def run(recipe):
+        state = create_train_state(
+            model, tx, jax.random.key(0), jnp.zeros((2, SIZE, SIZE, 3))
+        )
+        step = jax.jit(make_train_step(model, tx, lambda s: 0.1, scfg,
+                                       recipe=recipe))
+        for _ in range(3):
+            state, metrics = step(state, images, labels)
+        return state, jax.device_get(metrics)
+
+    cfg = config_lib.SupConConfig(recipe="supcon", batch_size=4)
+    config_lib.validate_recipe(cfg)
+    s_recipe, m_recipe = run(recipes_lib.build_recipe(cfg))
+    s_inline, m_inline = run(None)
+    assert_trees_bitwise(s_recipe.params, s_inline.params)
+    assert_trees_bitwise(s_recipe.batch_stats, s_inline.batch_stats)
+    assert_trees_bitwise(s_recipe.opt_state, s_inline.opt_state)
+    assert s_recipe.recipe_params is None and s_recipe.recipe_state is None
+    assert m_recipe == m_inline
+
+
+def test_byol_step_trains_predictor_and_ema_target():
+    state0, recipe, _ = _tiny_recipe("byol", n_steps=0)
+    target0 = jax.device_get(state0.recipe_state["target_params"])
+    pred0 = jax.device_get(state0.recipe_params)
+    state1, _, metrics = _tiny_recipe("byol", n_steps=1)
+    # predictor trained (joint gradient reached it)...
+    moved = jax.tree.map(
+        lambda a, b: not np.array_equal(np.asarray(a), np.asarray(b)),
+        pred0, jax.device_get(state1.recipe_params),
+    )
+    assert any(jax.tree.leaves(moved))
+    # ...the encoder trained THROUGH the predictor path...
+    enc_moved = jax.tree.map(
+        lambda a, b: not np.array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(state0.params), jax.device_get(state1.params),
+    )
+    assert any(jax.tree.leaves(enc_moved))
+    # ...and the post-step EMA is exactly tau*target + (1-tau)*new_online
+    tau = recipe.ema_momentum
+    expect = jax.tree.map(
+        lambda t, o: tau * np.asarray(t) + (1 - tau) * np.asarray(o),
+        target0, jax.device_get(state1.params),
+    )
+    got = jax.device_get(state1.recipe_state["target_params"])
+    for a, b in zip(jax.tree.leaves(expect), jax.tree.leaves(got)):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+    assert math.isfinite(metrics["loss"])
+
+
+def test_simsiam_step_trains():
+    state0, _, _ = _tiny_recipe("simsiam", n_steps=0)
+    state1, _, metrics = _tiny_recipe("simsiam", n_steps=2)
+    assert state1.recipe_state is None
+    moved = jax.tree.map(
+        lambda a, b: not np.array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(state0.recipe_params),
+        jax.device_get(state1.recipe_params),
+    )
+    assert any(jax.tree.leaves(moved))
+    assert math.isfinite(metrics["loss"])
+
+
+def test_queue_rotation_and_key_ema_in_step():
+    """One step writes exactly 2B detached KEY rows at the pointer and
+    advances it (untouched ring rows keep their seeded init), and the
+    momentum key encoder EMAs toward the online params — all in-program."""
+    batch = 4  # 2B = 8 rows/step into a 16-ring
+    state0, recipe, _ = _tiny_recipe("simclr", n_steps=0, batch=batch,
+                                     moco_queue=16)
+    q0 = np.asarray(jax.device_get(state0.recipe_state["queue_emb"]))
+    key0 = jax.device_get(state0.recipe_state["key_params"])
+    state1, _, _ = _tiny_recipe("simclr", n_steps=1, batch=batch,
+                                moco_queue=16)
+    q1 = np.asarray(jax.device_get(state1.recipe_state["queue_emb"]))
+    assert int(state1.recipe_state["queue_ptr"]) == 8
+    assert not np.array_equal(q1[:8], q0[:8])  # written
+    np.testing.assert_array_equal(q1[8:], q0[8:])  # untouched
+    np.testing.assert_allclose(  # unit rows: normalized keys landed
+        np.linalg.norm(q1[:8], axis=1), 1.0, rtol=1e-5,
+    )
+    # key encoder EMA'd exactly: m*key0 + (1-m)*new_online
+    m = recipe.ema_momentum
+    expect = jax.tree.map(
+        lambda k, o: m * np.asarray(k) + (1 - m) * np.asarray(o),
+        key0, jax.device_get(state1.params),
+    )
+    got = jax.device_get(state1.recipe_state["key_params"])
+    for a, b in zip(jax.tree.leaves(expect), jax.tree.leaves(got)):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+    state2, _, _ = _tiny_recipe("simclr", n_steps=2, batch=batch,
+                                moco_queue=16)
+    assert int(state2.recipe_state["queue_ptr"]) == 0  # wrapped
+
+
+def test_vicreg_metrics_stream_through_the_ring_keys():
+    _, recipe, metrics = _tiny_recipe("vicreg", n_steps=1)
+    expected = metric_keys(extra=recipe.metric_keys)
+    assert tuple(sorted(metrics)) == expected
+    for k in recipe.metric_keys:
+        assert math.isfinite(metrics[k])
+
+
+# ------------------------------------------------------ checkpoint hygiene
+
+
+def _byol_state_and_cfg():
+    cfg = config_lib.SupConConfig(
+        model="resnet10", feat_dim=16, predictor_hidden=32, batch_size=4,
+        recipe="byol",
+    )
+    config_lib.validate_recipe(cfg)
+    model = SupConResNet(model_name="resnet10", feat_dim=16)
+    tx = make_optimizer(0.1)
+    state = create_train_state(
+        model, tx, jax.random.key(0), jnp.zeros((2, SIZE, SIZE, 3))
+    )
+    return recipes_lib.attach_for_config(cfg, model, state), model
+
+
+def test_recipe_checkpoint_roundtrip_and_cross_recipe_hygiene(
+    tmp_path, caplog
+):
+    import logging
+
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    (state, recipe), model = _byol_state_and_cfg()
+    state, _, _ = _tiny_recipe("byol", n_steps=1)
+    save_checkpoint(
+        str(tmp_path), "ckpt", state, epoch=1,
+        extra_meta={"recipe": "byol", "moco_queue": 0},
+    )
+    saved_slots = jax.device_get({
+        "p": state.recipe_params, "o": state.recipe_opt_state,
+        "s": state.recipe_state,
+    })
+
+    # same recipe: the slots restore bitwise
+    (abstract, _), _ = _byol_state_and_cfg()
+    restored, meta = restore_checkpoint(
+        str(tmp_path / "ckpt"), abstract, recipe="byol"
+    )
+    assert meta["recipe"] == "byol"
+    assert_trees_bitwise(saved_slots, {
+        "p": restored.recipe_params, "o": restored.recipe_opt_state,
+        "s": restored.recipe_state,
+    })
+
+    # byol ckpt resumed under supcon (slot-free): encoder restores, the
+    # recipe payload is loudly ignored
+    cfg_sc = config_lib.SupConConfig(recipe="supcon", batch_size=4,
+                                     feat_dim=16)
+    config_lib.validate_recipe(cfg_sc)
+    model2 = SupConResNet(model_name="resnet10", feat_dim=16)
+    tx = make_optimizer(0.1)
+    plain = create_train_state(
+        model2, tx, jax.random.key(0), jnp.zeros((2, SIZE, SIZE, 3))
+    )
+    with caplog.at_level(logging.WARNING):
+        restored_sc, _ = restore_checkpoint(
+            str(tmp_path / "ckpt"), plain, recipe="supcon"
+        )
+    assert "recipe slots ignored" in caplog.text
+    assert restored_sc.recipe_params is None
+    assert restored_sc.recipe_state is None
+    assert_trees_bitwise(restored_sc.params, state.params)
+
+    # byol ckpt resumed under simsiam (different slot recipe): fresh init
+    caplog.clear()
+    cfg_ss = config_lib.SupConConfig(
+        recipe="simsiam", batch_size=4, feat_dim=16, predictor_hidden=32,
+    )
+    config_lib.validate_recipe(cfg_ss)
+    ss_state, _ = recipes_lib.attach_for_config(cfg_ss, model2, plain)
+    fresh = jax.device_get(ss_state.recipe_params)
+    with caplog.at_level(logging.WARNING):
+        restored_ss, _ = restore_checkpoint(
+            str(tmp_path / "ckpt"), ss_state, recipe="simsiam"
+        )
+    assert "recipe slots" in caplog.text and "start fresh" in caplog.text
+    assert_trees_bitwise(fresh, restored_ss.recipe_params)
+
+
+def test_queue_geometry_change_degrades_to_fresh(tmp_path, caplog):
+    """Same recipe, different --moco_queue across a resume: the meta-
+    recorded ring geometry gates the payload, so the queue/key-encoder
+    slots re-initialize loudly instead of restoring a mismatched ring."""
+    import logging
+
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    state, _, _ = _tiny_recipe("simclr", n_steps=1, moco_queue=16)
+    save_checkpoint(
+        str(tmp_path), "ckpt", state, epoch=1,
+        extra_meta={"recipe": "simclr", "moco_queue": 16},
+    )
+    abstract, _, _ = _tiny_recipe("simclr", n_steps=0, moco_queue=24)
+    fresh = jax.device_get(abstract.recipe_state)
+    with caplog.at_level(logging.WARNING):
+        restored, _ = restore_checkpoint(
+            str(tmp_path / "ckpt"), abstract, recipe="simclr", moco_queue=24
+        )
+    assert "ring geometry changed" in caplog.text
+    assert_trees_bitwise(fresh, restored.recipe_state)
+    # same geometry restores bitwise
+    abstract2, _, _ = _tiny_recipe("simclr", n_steps=0, moco_queue=16)
+    restored2, _ = restore_checkpoint(
+        str(tmp_path / "ckpt"), abstract2, recipe="simclr", moco_queue=16
+    )
+    assert_trees_bitwise(
+        jax.device_get(state.recipe_state), restored2.recipe_state
+    )
+
+
+def test_supcon_ckpt_resumed_under_byol_degrades_to_fresh(tmp_path, caplog):
+    import logging
+
+    from simclr_pytorch_distributed_tpu.utils.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    state, _, _ = _tiny_recipe("supcon", n_steps=1)
+    assert state.recipe_params is None  # slot-free checkpoint
+    save_checkpoint(
+        str(tmp_path), "ckpt", state, epoch=1,
+        extra_meta={"recipe": "supcon", "moco_queue": 0},
+    )
+    (byol_state, _), _ = _byol_state_and_cfg()
+    fresh = jax.device_get({
+        "p": byol_state.recipe_params, "s": byol_state.recipe_state,
+    })
+    with caplog.at_level(logging.WARNING):
+        restored, _ = restore_checkpoint(
+            str(tmp_path / "ckpt"), byol_state, recipe="byol"
+        )
+    assert "no recipe payload" in caplog.text
+    assert_trees_bitwise(fresh, {
+        "p": restored.recipe_params, "s": restored.recipe_state,
+    })
+    assert_trees_bitwise(restored.params, state.params)
+
+
+# ------------------------------------------------- driver-level proofs
+
+
+@pytest.fixture
+def tiny_driver(monkeypatch):
+    """The test_telemetry tiny-driver rig: 200-sample size-8 synthetic set,
+    1-device mesh (multi-way sharding is test_distributed's job)."""
+    from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib
+    from simclr_pytorch_distributed_tpu.parallel import mesh as mesh_lib
+    from simclr_pytorch_distributed_tpu.train import supcon as supcon_driver
+
+    orig = cifar_lib.synthetic_dataset
+
+    def small(n=2048, num_classes=10, seed=0, size=32):
+        return orig(n=200, num_classes=num_classes, seed=seed, size=SIZE)
+
+    monkeypatch.setattr(cifar_lib, "synthetic_dataset", small)
+
+    def limited_create_mesh(devices=None, **kw):
+        if devices is None:
+            devices = jax.devices()[:1]
+        return mesh_lib.create_mesh(devices=devices, **kw)
+
+    monkeypatch.setattr(supcon_driver, "create_mesh", limited_create_mesh)
+    return supcon_driver
+
+
+def _driver_cfg(tmp_path, sub, **over):
+    base = dict(
+        model="resnet10", dataset="synthetic", batch_size=32, epochs=1,
+        learning_rate=0.05, cosine=True, save_freq=5, print_freq=2,
+        size=SIZE, workdir=str(tmp_path / sub), seed=0, method="SimCLR",
+        telemetry="sync", data_placement="host", predictor_hidden=32,
+        feat_dim=16,
+    )
+    base.update(over)
+    return config_lib.finalize_supcon(config_lib.SupConConfig(**base))
+
+
+RECIPE_SMOKE_ARMS = [
+    ("byol", {}),
+    ("simsiam", {}),
+    ("vicreg", {}),
+    ("simclr", {"moco_queue": 128}),  # 2B=64 rows/step into a 128-ring
+]
+
+
+@pytest.mark.parametrize("recipe,over", RECIPE_SMOKE_ARMS,
+                         ids=[r for r, _ in RECIPE_SMOKE_ARMS])
+def test_recipe_driver_smoke_host(tmp_path, tiny_driver, recipe, over):
+    """The recipe<->driver consume-signature contract, host placement: one
+    sync-mode epoch per recipe through the REAL trainer (the
+    test_all_drivers_flush_boundary_smoke convention — sync telemetry runs
+    every window job inline, so a diverged signature raises HERE, in
+    tier-1). The device-placement half of this smoke is the zero-sync
+    transfer proof below."""
+    cfg = _driver_cfg(tmp_path, recipe, recipe=recipe, **over)
+    state = tiny_driver.run(cfg)
+    assert int(state.step) == 5  # 160 train samples / batch 32
+
+
+@pytest.mark.parametrize("recipe,over", RECIPE_SMOKE_ARMS,
+                         ids=[r for r, _ in RECIPE_SMOKE_ARMS])
+def test_recipe_zero_sync_device_placement(
+    tmp_path, tiny_driver, monkeypatch, recipe, over
+):
+    """The PR-4/PR-5 mechanical transfer contract re-proven per recipe
+    (the acceptance bar): one real epoch under DEVICE placement with
+    health_freq=1 + the online probe + the recipe on counts EXACTLY 3 ring
+    D2H (windows 2+2+1) and 1 index upload — EMA updates, queue rotation,
+    and the extra target forward all stay in-program. Doubles as the
+    device-placement driver smoke."""
+    from simclr_pytorch_distributed_tpu.data import device_store
+    from simclr_pytorch_distributed_tpu.utils.telemetry import TelemetrySession
+
+    counts = {"ring": 0, "index": 0}
+
+    class CountingSession(TelemetrySession):
+        def __init__(self, window, keys, mode="async", **kw):
+            def counting_get(x):
+                counts["ring"] += 1
+                return jax.device_get(x)
+
+            super().__init__(window, keys, mode, device_get=counting_get, **kw)
+
+    real_store = device_store.DeviceStore
+
+    class CountingStore(real_store):
+        def __init__(self, loader, mesh, **kw):
+            super().__init__(loader, mesh, **kw)
+            inner = self._index_put
+
+            def counting_put(idx):
+                counts["index"] += 1
+                return inner(idx)
+
+            self._index_put = counting_put
+
+    monkeypatch.setattr(tiny_driver, "TelemetrySession", CountingSession)
+    monkeypatch.setattr(device_store, "DeviceStore", CountingStore)
+
+    cfg = _driver_cfg(
+        tmp_path, recipe, recipe=recipe, data_placement="device",
+        flight_recorder="on", health_freq=1, online_probe="on",
+        health_policy="warn", **over,
+    )
+    tiny_driver.run(cfg)
+    assert counts == {"ring": 3, "index": 1}
+
+    # the health stream flowed through those same transfers, recipe keys
+    # included, and the recipe marker landed on the recorder
+    events_path = os.path.join(cfg.save_folder, "events.jsonl")
+    events = [json.loads(x) for x in open(events_path).read().splitlines()]
+    windows = [e for e in events if e["name"] == "health_window"]
+    assert len(windows) == 3
+    markers = [e for e in events if e["name"] == "run_recipe"]
+    assert markers and markers[0]["args"]["recipe"] == recipe
+    if recipe == "vicreg":
+        last = windows[-1]["args"]
+        for k in ("vicreg_cov", "vicreg_inv", "vicreg_var"):
+            assert k in last and math.isfinite(last[k])
+    assert not [e for e in events if e["name"] == "health_alarm"]
+
+
+@pytest.mark.parametrize("placement", ["host", "device"])
+def test_supcon_recipe_bitwise_vs_prerefactor_driver(
+    tmp_path, tiny_driver, placement
+):
+    """THE acceptance bar: --recipe supcon through the interface produces
+    bitwise-identical params to the pre-refactor update over a 2-epoch
+    REAL-driver run, host and device placement. The pre-refactor arm is
+    the retained inline path (make_fused_update(recipe=None)) — the
+    contrastive term itself is shared, so this pins the neutrality of
+    everything the refactor wrapped around it."""
+    orig_mfu = tiny_driver.make_fused_update
+
+    def run(arm):
+        if arm == "legacy":
+            def legacy_mfu(*a, **kw):
+                kw["recipe"] = None
+                return orig_mfu(*a, **kw)
+
+            tiny_driver.make_fused_update = legacy_mfu
+        try:
+            cfg = _driver_cfg(
+                tmp_path, f"{placement}_{arm}", recipe="supcon",
+                method="SupCon", epochs=2, data_placement=placement,
+            )
+            return tiny_driver.run(cfg)
+        finally:
+            tiny_driver.make_fused_update = orig_mfu
+
+    s_recipe = run("recipe")
+    s_legacy = run("legacy")
+    assert int(s_recipe.step) == 10
+    assert_trees_bitwise(s_recipe.params, s_legacy.params)
+    assert_trees_bitwise(s_recipe.batch_stats, s_legacy.batch_stats)
+    assert_trees_bitwise(s_recipe.opt_state, s_legacy.opt_state)
+
+
+COLLAPSE_ARMS = [
+    ("byol", {"byol_predictor": "none"}),  # the ABLATED form: no asymmetry
+    ("simsiam", {}),
+    ("vicreg", {}),
+]
+
+
+@pytest.mark.parametrize("recipe,over", COLLAPSE_ARMS,
+                         ids=[r for r, _ in COLLAPSE_ARMS])
+def test_recipe_collapse_injection_trips_code3_abort(
+    tmp_path, tiny_driver, monkeypatch, recipe, over
+):
+    """Per-recipe collapse injection (the test_health pattern): constant
+    embeddings through the REAL driver under each recipe must trip the
+    per-recipe windowed detector and — under --health_policy abort — exit
+    with the typed RepresentationHealthError via the collective code-3
+    exchange. The BYOL arm runs predictor-ABLATED (--byol_predictor none):
+    the known-collapsing configuration the raised eff-rank bar exists for.
+    """
+    from simclr_pytorch_distributed_tpu.recipes import byol as byol_mod
+
+    def constant_forward(model, params, batch_stats, images, *, train=True,
+                         with_features=False):
+        B = images.shape[0]
+        feats = jnp.ones((2 * B, 16), jnp.float32)
+        if with_features:
+            return (feats, feats), batch_stats
+        return feats, batch_stats
+
+    # both forward call sites: the step's online forward AND the BYOL
+    # target forward (recipes/byol.py binds the name at import)
+    monkeypatch.setattr(supcon_step, "two_view_forward", constant_forward)
+    monkeypatch.setattr(byol_mod, "two_view_forward", constant_forward)
+
+    cfg = _driver_cfg(
+        tmp_path, recipe, recipe=recipe, epochs=2,
+        health_freq=1, health_policy="abort", flight_recorder="on", **over,
+    )
+    with pytest.raises(RepresentationHealthError, match="collapse"):
+        tiny_driver.run(cfg)
+
+    events_path = os.path.join(cfg.save_folder, "events.jsonl")
+    events = [json.loads(x) for x in open(events_path).read().splitlines()]
+    alarms = [e for e in events if e["name"] == "health_alarm"]
+    assert alarms and alarms[0]["args"]["policy"] == "abort"
+    failures = [e for e in events if e["name"] == "flush_failure"]
+    assert failures and failures[0]["args"]["code"] == 3
+
+
+# ------------------------------------- offline readers + the ratchet gate
+
+
+def _window_event(step, **over):
+    args = {
+        "health_align": 0.5, "health_con_top1": 30.0,
+        "health_eff_rank": 2.5, "health_grad_norm": 5.0,
+        "health_neg_max": 0.7, "health_neg_mean": 0.4, "health_unif": -2.0,
+        "step": step,
+    }
+    args.update(over)
+    return {"name": "health_window", "track": "health", "ph": "i",
+            "ts": 0.1 * step, "args": args}
+
+
+def test_health_report_recipe_aware_collapse_signature():
+    """eff_rank 2.5 is healthy under the contrastive bar (2.0) but COLLAPSED
+    under the byol/simsiam bar (3.0): the offline reader must reach the
+    same verdict as the live per-recipe monitor, keyed off the stream's
+    run_recipe event (or the --recipe override)."""
+    import scripts.health_report as hr
+
+    marker = {"name": "run_recipe", "track": "main:guard", "ph": "i",
+              "ts": 0.0, "args": {"recipe": "byol", "moco_queue": 0}}
+    rep = hr.build_report([marker, _window_event(2)])
+    assert rep["recipe"] == "byol"
+    assert rep["thresholds"]["eff_rank_min"] == 3.0
+    assert any(f["kind"] == "collapse_signature" for f in rep["findings"])
+
+    # same stream, contrastive recipe: no finding
+    marker_sc = {"name": "run_recipe", "track": "main:guard", "ph": "i",
+                 "ts": 0.0, "args": {"recipe": "simclr", "moco_queue": 0}}
+    rep = hr.build_report([marker_sc, _window_event(2)])
+    assert not any(
+        f["kind"] == "collapse_signature" for f in rep["findings"]
+    )
+
+    # explicit override beats the recorded marker
+    rep = hr.build_report([marker_sc, _window_event(2)], recipe="simsiam")
+    assert rep["recipe"] == "simsiam"
+    assert any(f["kind"] == "collapse_signature" for f in rep["findings"])
+
+
+def _eval_artifact(device="cpu", **over):
+    base = {
+        "schema": "recipes_eval/v1", "device": device, "smoke": True,
+        "config": {},
+        "bit_identity": {"ok": True, "epochs": 2, "steps": 10,
+                         "placements": {"host": True, "device": True}},
+        "recipes": {
+            name: {"recipe": name.split("_")[0], "moco_queue": 0,
+                   "probe_best_top1": 60.0, "probe_first_top1": 12.0,
+                   "probe_last_top1": 55.0, "windows": 3, "alarms": 0,
+                   "consistency_ok": True,
+                   "thresholds": {"eff_rank_min": 2.0}}
+            for name in ("supcon", "byol", "simsiam", "vicreg",
+                         "simclr_queue")
+        },
+    }
+    base.update(over)
+    return base
+
+
+def test_recipe_gate_record_pass_fail_and_skip():
+    import scripts.ratchet as ratchet
+
+    rec = ratchet.recipe_gate_record(_eval_artifact())
+    assert rec["ok"] and "skipped" not in rec
+
+    # bit-identity failure binds everywhere
+    bad = _eval_artifact(device="tpu")
+    bad["bit_identity"] = {"ok": False,
+                           "placements": {"host": True, "device": False}}
+    rec = ratchet.recipe_gate_record(bad)
+    assert not rec["ok"] and "bit-identity" in rec["error"]
+
+    # a collapse alarm binds everywhere
+    bad = _eval_artifact(device="tpu")
+    bad["recipes"]["byol"]["alarms"] = 2
+    rec = ratchet.recipe_gate_record(bad)
+    assert not rec["ok"] and "false positive" in rec["error"]
+
+    # probe bar binds on CPU...
+    low = _eval_artifact()
+    low["recipes"]["simsiam"]["probe_best_top1"] = 11.0
+    rec = ratchet.recipe_gate_record(low)
+    assert not rec["ok"] and "did not learn" in rec["error"]
+    # ...and pass-skips elsewhere with the reason on record
+    low_tpu = _eval_artifact(device="tpu")
+    low_tpu["recipes"]["simsiam"]["probe_best_top1"] = 11.0
+    rec = ratchet.recipe_gate_record(low_tpu)
+    assert rec["ok"] and "calibrated" in rec["skipped"]
+
+    # a missing arm fails
+    missing = _eval_artifact()
+    del missing["recipes"]["vicreg"]
+    rec = ratchet.recipe_gate_record(missing)
+    assert not rec["ok"] and "missing" in rec["error"]
+
+
+def test_recipes_eval_build_output_schema_pinned():
+    import scripts.recipes_eval as ev
+
+    out = ev.build_output(
+        "cpu", True, {"epochs": 1}, {"ok": True, "placements": {}}, {},
+    )
+    assert set(out) == {"schema", "device", "smoke", "config",
+                        "bit_identity", "recipes"}
+    assert out["schema"] == ev.SCHEMA
+    # the bars the gate binds against exist for every shipped probe arm
+    import scripts.ratchet as ratchet
+
+    assert set(ratchet.RECIPE_PROBE_CPU_BARS) == {
+        name for name, _ in ev.PROBE_ARMS
+    }
