@@ -350,6 +350,22 @@ def main(argv=None):
         help="device-side negative queue size for the simclr recipe arm "
              "(multiple of 2*batch_size; forces the dense loss path)",
     )
+    ap.add_argument(
+        "--ledger", nargs="?", const="docs/perf_ledger.jsonl", default="",
+        metavar="PATH",
+        help="append this run to the longitudinal perf ledger "
+             "(scripts/perf_ledger.py: git rev + workload fingerprint + "
+             "throughput per record; default path docs/perf_ledger.jsonl)",
+    )
+    ap.add_argument(
+        "--ledger_phases", default="", metavar="TRACE_REPORT_JSON",
+        help="a trace_report artifact whose per-phase shares ride the "
+             "ledger record (drift becomes attributable to a phase)",
+    )
+    ap.add_argument(
+        "--ledger_note", default="",
+        help="free-form provenance note on the ledger record",
+    )
     args = ap.parse_args(argv)
     if args.stem != "conv" and args.stage != "pretrain":
         ap.error("--stem applies to --stage pretrain only")
@@ -449,7 +465,7 @@ def main(argv=None):
         (bytes_accessed * n_steps / dt) / (peak_hbm * 1e9)
         if bytes_accessed > 0 else 0.0
     )
-    print(json.dumps({
+    record = {
         "metric": f"{metric_stage}_imgs_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "imgs/s/chip",
@@ -488,7 +504,30 @@ def main(argv=None):
             "selection": "median of credible windows (implied MFU <= 0.7)",
             "config": config_str,
         },
-    }))
+    }
+    print(json.dumps(record))
+    if args.ledger:
+        # the longitudinal record: one line per bench run, fingerprinted by
+        # workload identity so only like compares with like
+        import os
+        import sys as _sys
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        _sys.path.insert(0, os.path.join(repo, "scripts"))
+        import perf_ledger
+
+        # relative paths anchor at the REPO, not the cwd: the committed
+        # ledger is what perf_ledger.py check and the ratchet gate read —
+        # a cwd-relative default would grow a stray history instead
+        ledger_path = args.ledger
+        if not os.path.isabs(ledger_path):
+            ledger_path = os.path.join(repo, ledger_path)
+        ledger_rec = perf_ledger.append_from_bench(
+            ledger_path, record, phases_path=args.ledger_phases,
+            note=args.ledger_note,
+        )
+        print(f"ledger: appended {ledger_rec['fingerprint']} "
+              f"@ {ledger_rec['git_rev']} -> {ledger_path}")
 
 
 if __name__ == "__main__":

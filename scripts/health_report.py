@@ -28,6 +28,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from simclr_pytorch_distributed_tpu.utils import tracing  # noqa: E402
 from simclr_pytorch_distributed_tpu.utils.guard import (  # noqa: E402
     thresholds_for_recipe,
 )
@@ -69,13 +70,34 @@ EVENT_FLAGS = {
 }
 
 
+def session_paths(path):
+    """The files one ``--events`` argument selects.
+
+    The BASE session file (``events.jsonl`` / ``events_pN.jsonl``) expands
+    to the process's whole session family — a resumed run (the exit-75
+    relaunch lands in the same save_folder) rotates to ``events_r2.jsonl``,
+    ``events_r3.jsonl``, ... (utils/tracing.run_paths), and reading only
+    the first file silently truncated a resumed run's health timeline at
+    the first preemption. An EXPLICIT rotated file (``events_r2.jsonl``)
+    selects exactly that session: asking for one session must not be
+    silently overridden with the whole family."""
+    m = tracing.EVENTS_FILE_RE.match(os.path.basename(path))
+    if m and not m.group(3):
+        return tracing.session_files_for(path)
+    return [path]
+
+
 def load_events(path):
+    """The selected session(s), concatenated in session order (see
+    :func:`session_paths`). Health windows key on the GLOBAL step
+    (restored across resumes), not the per-session clock, so
+    concatenation keeps the timeline monotone and the consistency checks
+    meaningful. Each file is read through the shared torn-line-tolerant
+    loader (tracing.parse_jsonl): the half-written final line a SIGKILL
+    leaves is exactly the run this report exists to diagnose."""
     events = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                events.append(json.loads(line))
+    for session_path in session_paths(path):
+        events.extend(tracing.load_events_jsonl(session_path))
     return events
 
 
@@ -211,15 +233,20 @@ def render_table(report):
     return "\n".join(lines)
 
 
-def build_output(events_path, report, device):
+def build_output(events_path, report, device, session_files=None):
     """The committed artifact (pure; schema pinned by tests). ``device`` is
     the analyzing host's jax backend — the ratchet gate runs the trainer and
     this report on the same box, and uses it to scope the CPU-calibrated
-    probe-accuracy claim."""
-    return {
+    probe-accuracy claim. ``session_files`` records the files ACTUALLY
+    read (a base ``--events`` expands to the whole rotated-session
+    family), so the artifact's provenance never understates its input."""
+    out = {
         "schema": SCHEMA, "events": events_path, "device": device,
         "report": report,
     }
+    if session_files is not None:
+        out["session_files"] = [os.path.basename(p) for p in session_files]
+    return out
 
 
 def main(argv=None):
@@ -254,7 +281,10 @@ def main(argv=None):
 
         with open(args.json, "w") as f:
             json.dump(
-                build_output(args.events, report, jax.default_backend()),
+                build_output(
+                    args.events, report, jax.default_backend(),
+                    session_files=session_paths(args.events),
+                ),
                 f, indent=1,
             )
         print(f"wrote {args.json}")

@@ -52,6 +52,8 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, SCRIPTS)  # perf_ledger (scripts/ is not a package)
 
 
 def _bench_bar():
@@ -165,6 +167,31 @@ CONFIGS = {
     # rides the default list.
     "recipes": dict(model="resnet10", epochs=1, bar=None, kind="recipes",
                     dataset="synthetic"),
+    # round 13: the fleet-merge gate. Binds on the COMMITTED evidence
+    # artifact (docs/evidence/fleet_report_r13.json, produced by
+    # scripts/trace_report.py --fleet over a REAL 2-process gloo run —
+    # tests/multiprocess_child.py driver mode): the pure fleet_gate_record
+    # re-verifies merge consistency everywhere, hardware-independently
+    # (the trace_report convention) — a multi-process session whose
+    # per-process timelines anchored to sub-tolerance residual, whole
+    # collective boundaries, per-process attribution intact, and a
+    # non-empty skew table. Re-produce the artifact with a 2-process run
+    # when the anchor/collective instrumentation changes; instant, so it
+    # rides the default list.
+    "fleet_report": dict(model=None, epochs=0, bar=None, kind="fleet_report",
+                         dataset=None,
+                         artifact="docs/evidence/fleet_report_r13.json"),
+    # round 13: the longitudinal perf-ledger gate. Runs the pure
+    # regression scan (scripts/perf_ledger.py detect_regression) over the
+    # COMMITTED docs/perf_ledger.jsonl: schema validity binds everywhere;
+    # the regression bar binds only within same-fingerprint groups (stage
+    # + config + device kind + chips), clock-suspect runs excluded on both
+    # sides, and groups without a sufficient clean trailing window
+    # pass-skip with the reason on record (the bench gate's device-kind
+    # convention, applied to history). Instant, so it rides the default
+    # list.
+    "perf_ledger": dict(model=None, epochs=0, bar=None, kind="perf_ledger",
+                        dataset=None, artifact="docs/perf_ledger.jsonl"),
 }
 
 # CPU-calibrated bar for the health_report smoke's online probe: best
@@ -549,6 +576,109 @@ def supervisor_gate_record(artifact):
     return record
 
 
+def fleet_gate_record(artifact):
+    """Gate decision for the fleet-merge evidence artifact (pure — tested
+    without running a pod).
+
+    Binds everywhere, hardware-independently (the trace_report
+    convention): the claims are properties of the merge, not of timing
+    numbers. Checks: every session in the artifact merged consistently
+    (anchors fit each non-reference process to sub-tolerance residual,
+    collective boundaries whole across processes, per-process attribution
+    intact), and at least one session is a REAL multi-process merge with a
+    non-empty skew table — a single-process artifact would prove nothing
+    about cross-process clock alignment.
+    """
+    sessions = artifact.get("sessions", {})
+    record = {
+        "metric": "ratchet_fleet_report",
+        "value": len(sessions),
+        "sessions": sorted(sessions),
+    }
+
+    def fail(msg):
+        record["ok"] = False
+        record["error"] = msg
+        return record
+
+    if artifact.get("schema") != "fleet_report/v1":
+        return fail(f"unexpected schema {artifact.get('schema')!r}")
+    if not sessions:
+        return fail("no merged sessions in the fleet artifact")
+    multi = 0
+    residuals = []
+    for label, rep in sorted(sessions.items()):
+        cons = rep.get("consistency", {})
+        if not cons.get("ok"):
+            return fail(f"session {label}: merge inconsistent ({cons})")
+        residuals.append(cons.get("max_residual_s", 0.0))
+        if cons.get("n_processes", 0) >= 2:
+            multi += 1
+    if not multi:
+        return fail(
+            "no multi-process session: the fleet evidence must come from "
+            "a >=2-process run"
+        )
+    record["multi_process_sessions"] = multi
+    record["max_residual_s"] = max(residuals)
+    record["stragglers"] = {
+        label: (rep["straggler_ranking"][0]["process"]
+                if rep.get("straggler_ranking") else None)
+        for label, rep in sorted(sessions.items())
+    }
+    record["ok"] = True
+    return record
+
+
+def ledger_gate_record(records):
+    """Gate decision for the committed perf ledger (pure — tested on
+    synthetic record lists).
+
+    Schema validity binds on EVERY device (the ledger is just history).
+    The regression bar binds only where history makes it meaningful: the
+    latest clean record of each workload fingerprint vs the median of its
+    trailing clean window (scripts/perf_ledger.py detect_regression —
+    clock-suspect runs excluded on both sides, the bench-gate convention);
+    groups without a sufficient window pass-skip with the reason on
+    record.
+    """
+    import perf_ledger  # scripts/ dir on sys.path; imports no jax
+
+    record = {"metric": "ratchet_perf_ledger", "value": len(records)}
+
+    def fail(msg):
+        record["ok"] = False
+        record["error"] = msg
+        return record
+
+    if not records:
+        return fail("empty perf ledger: bench.py --ledger never ran")
+    errors = perf_ledger.schema_errors(records)
+    if errors:
+        return fail(f"ledger schema errors: {errors}")
+    verdicts = perf_ledger.detect_regression(records)
+    record["verdicts"] = verdicts
+    record["skipped"] = {
+        fp: v["reason"] for fp, v in verdicts.items()
+        if v["status"] == "skipped"
+    }
+    regressions = {
+        fp: v for fp, v in verdicts.items() if v["status"] == "regression"
+    }
+    if regressions:
+        return fail(
+            "perf regression vs the trailing same-fingerprint window: "
+            + "; ".join(
+                f"{v.get('stage')}@{v.get('device_kind')} "
+                f"{v['value']:.1f} vs median {v['baseline_median']:.1f} "
+                f"(ratio {v['ratio']:.3f}, rev {v.get('latest_rev')})"
+                for v in regressions.values()
+            )
+        )
+    record["ok"] = True
+    return record
+
+
 class ConfigFailed(RuntimeError):
     """One gated config could not produce a number; the others must still run."""
 
@@ -576,20 +706,13 @@ def best_acc(log_path):
 
 
 def parse_bench_json(log_path):
-    """bench.py's headline record: the LAST parseable JSON line carrying a
-    'metric' key (warmup/progress noise above it is ignored)."""
-    record = None
-    with open(log_path) as f:
-        for line in f:
-            line = line.strip()
-            if not line.startswith("{"):
-                continue
-            try:
-                obj = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(obj, dict) and "metric" in obj:
-                record = obj
+    """bench.py's headline record (the shared parser in
+    scripts/perf_ledger.py — the bench-stdout contract lives in ONE
+    place), raised as ConfigFailed here so a dead bench config keeps the
+    other gates running."""
+    import perf_ledger
+
+    record = perf_ledger.parse_bench_json(log_path)
     if record is None:
         raise ConfigFailed(f"no bench JSON record in {log_path}")
     return record
@@ -756,6 +879,35 @@ def run_config(name, spec, epochs, bar, args):
         print(json.dumps(record), flush=True)
         return record
 
+    if kind == "fleet_report":
+        # binds on the COMMITTED fleet-merge evidence artifact (CONFIGS
+        # note): re-produce it with a 2-process run + trace_report --fleet
+        # when the anchor/collective instrumentation changes
+        path = os.path.join(REPO, spec["artifact"])
+        try:
+            with open(path) as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ConfigFailed(
+                f"no readable fleet evidence at {path}: {e}"
+            ) from e
+        record = fleet_gate_record(artifact)
+        record["bar"] = bar
+        record["artifact"] = spec["artifact"]
+        print(json.dumps(record), flush=True)
+        return record
+
+    if kind == "perf_ledger":
+        # the pure regression scan over the committed longitudinal ledger
+        import perf_ledger
+
+        path = os.path.join(REPO, spec["artifact"])
+        record = ledger_gate_record(perf_ledger.load_ledger(path))
+        record["bar"] = bar
+        record["artifact"] = spec["artifact"]
+        print(json.dumps(record), flush=True)
+        return record
+
     if kind == "supervisor_gate":
         # binds on the COMMITTED scenario-matrix evidence artifact (see the
         # CONFIGS note): no subprocess — the matrix itself is re-run with
@@ -877,6 +1029,10 @@ def main():
                 metric = "ratchet_health_report"
             elif spec["kind"] == "supervisor_gate":
                 metric = "ratchet_supervisor_matrix"
+            elif spec["kind"] == "fleet_report":
+                metric = "ratchet_fleet_report"
+            elif spec["kind"] == "perf_ledger":
+                metric = "ratchet_perf_ledger"
             elif spec["kind"] == "recipes":
                 metric = "ratchet_recipes"
             elif spec["kind"] in ("resident_ab", "window_ab"):

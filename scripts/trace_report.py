@@ -16,9 +16,24 @@ the committed ``docs/evidence/trace_report_r*.json`` convention, and the
 (which binds on the attribution's internal consistency: phases
 non-negative and non-overlapping, the table summing to the wall time).
 
+``--fleet <run_dir>`` is the MULTI-PROCESS view: a pod writes one
+``events_pN.jsonl`` per process on unaligned per-host monotonic clocks.
+This mode discovers every session's per-process files, aligns the
+timelines through the ``clock_anchor`` events each process stamps at
+already-matched collective points (affine fit per process, residual
+reported — utils/tracing.py), and emits: a merged Chrome trace (``pid`` =
+process index), a per-collective skew table naming the straggler process
+at each boundary (arrival = the ``main:collective`` span's start), a
+straggler ranking, and per-process attribution consistency checks — all
+through the pure ``build_fleet_report`` (the committed
+``docs/evidence/fleet_report_r*.json`` convention, gate-verified by
+ratchet's ``fleet_report`` config).
+
 Usage:
     python scripts/trace_report.py --events <run_dir>/events.jsonl \
         [--json out.json]
+    python scripts/trace_report.py --fleet <run_dir> \
+        [--json out.json] [--trace merged_trace.json]
 """
 
 import argparse
@@ -28,12 +43,22 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from simclr_pytorch_distributed_tpu.utils import tracing  # noqa: E402
 from simclr_pytorch_distributed_tpu.utils.tracing import (  # noqa: E402
+    ANCHOR_EVENT,
     EPOCH_TRACK,
     MAIN_TRACK_PREFIX,
+    chrome_trace_from_events,
 )
 
 SCHEMA = "trace_report/v1"
+FLEET_SCHEMA = "fleet_report/v1"
+COLLECTIVE_TRACK = "main:collective"
+# max acceptable affine-fit residual: the anchors are post-release stamps
+# of one physical instant, so after the per-process affine map they must
+# agree to within collective release jitter (ms-scale even on a loaded
+# CPU host; a residual past this means the merge cannot be trusted)
+FLEET_RESIDUAL_TOL_S = 0.25
 
 # advisory share thresholds per phase (fraction of wall): above them the
 # phase is flagged — not an error, a "look here first" pointer
@@ -50,20 +75,19 @@ EVENT_FLAGS = {
     "nan_rollback": "NaN rollback(s) recorded",
     "preempt_exit": "run ended by preemption",
     "flush_failure": "telemetry flush failure observed",
+    "recorder_dropped": "flight-recorder ring saturated: trace.json and "
+                        "watchdog snapshots truncated (events.jsonl is "
+                        "complete)",
 }
 # span overlap tolerance (s): clock reads bracketing a record are not atomic
 OVERLAP_TOL_S = 1e-4
 
 
 def load_events(path):
-    events = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            events.append(json.loads(line))
-    return events
+    """One session's records — the shared torn-line-tolerant loader
+    (tracing.parse_jsonl): the half-written final line a SIGKILL leaves
+    behind is exactly the run this report exists to diagnose."""
+    return tracing.load_events_jsonl(path)
 
 
 def _attributed_tracks(events):
@@ -197,14 +221,317 @@ def build_output(events_path, report):
     return {"schema": SCHEMA, "events": events_path, "report": report}
 
 
+# ------------------------------------------------------------------ fleet
+
+
+def anchor_points(events):
+    """``{anchor_seq: local_ts}`` of one process's clock anchors."""
+    out = {}
+    for e in events:
+        if e.get("name") == ANCHOR_EVENT and e.get("ph") == "i":
+            args = e.get("args", {})
+            if "anchor" in args:
+                out[int(args["anchor"])] = float(e["ts"])
+    return out
+
+
+def fit_alignment(ref_anchors, anchors):
+    """Affine map local -> reference clock over the matched anchor seqs
+    (pure). Least squares over >=2 anchors recovers offset AND rate drift;
+    one anchor degrades to offset-only (scale pinned at 1); zero matched
+    anchors means the timelines cannot be merged (``residual_s`` None).
+    ``residual_s`` is the MAX absolute fit error — the merge's error bar,
+    gated against :data:`FLEET_RESIDUAL_TOL_S`."""
+    seqs = sorted(set(ref_anchors) & set(anchors))
+    n = len(seqs)
+    if n == 0:
+        return {"scale": 1.0, "offset_s": 0.0, "residual_s": None,
+                "n_anchors": 0}
+    xs = [anchors[s] for s in seqs]
+    ys = [ref_anchors[s] for s in seqs]
+    if n == 1:
+        a, b = 1.0, ys[0] - xs[0]
+    else:
+        mx, my = sum(xs) / n, sum(ys) / n
+        sxx = sum((x - mx) ** 2 for x in xs)
+        sxy = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+        a = sxy / sxx if sxx > 0 else 1.0
+        b = my - a * mx
+    residual = max(abs(a * x + b - y) for x, y in zip(xs, ys))
+    return {"scale": a, "offset_s": round(b, 6),
+            "residual_s": round(residual, 6), "n_anchors": n}
+
+
+def _aligned(alignment, ts):
+    return alignment["scale"] * ts + alignment["offset_s"]
+
+
+def build_fleet_report(events_by_process, residual_tol_s=FLEET_RESIDUAL_TOL_S):
+    """One session's merged fleet view (pure — tests drive it on synthetic
+    per-process event lists).
+
+    ``events_by_process`` maps process index -> that process's records.
+    The lowest process index is the reference clock; every other process
+    is affine-fitted onto it through the matched ``clock_anchor`` events.
+    Collective spans (``main:collective``) are matched across processes by
+    (name, per-process occurrence index) — valid because the collective
+    call SCHEDULE is identical across processes (the repo's documented
+    deadlock invariant); a span's start is that process's ARRIVAL at the
+    boundary, so the aligned arrival spread is the boundary's skew and the
+    latest arrival is its straggler.
+    """
+    if not events_by_process:
+        raise ValueError("no per-process event lists: empty fleet?")
+    pids = sorted(events_by_process)
+    ref = pids[0]
+    anchors = {p: anchor_points(events_by_process[p]) for p in pids}
+    alignments = {
+        p: fit_alignment(anchors[ref], anchors[p]) for p in pids
+    }
+
+    processes = {}
+    attribution_ok = True
+    for p in pids:
+        try:
+            rep = build_report(events_by_process[p])
+            ok = bool(rep["consistency"]["ok"])
+        except ValueError:
+            ok = False
+        attribution_ok = attribution_ok and ok
+        processes[str(p)] = {
+            "n_events": len(events_by_process[p]),
+            "n_anchors": len(anchors[p]),
+            "alignment": alignments[p],
+            "attribution_ok": ok,
+        }
+
+    # collective spans, grouped by (name, occurrence) across processes;
+    # skew is a CROSS-process spread, so a single-process merge has no
+    # skew table (not a table of zeros)
+    groups = {}
+    for p in (pids if len(pids) > 1 else ()):
+        counters = {}
+        for e in events_by_process[p]:
+            if e.get("ph") == "X" and e.get("track") == COLLECTIVE_TRACK:
+                i = counters.get(e["name"], 0)
+                counters[e["name"]] = i + 1
+                groups.setdefault((e["name"], i), {})[p] = {
+                    "arrival": _aligned(alignments[p], e["ts"]),
+                    "wait_s": e.get("dur", 0.0),
+                    "step": e.get("args", {}).get("step"),
+                }
+    skew_table = []
+    incomplete = 0
+    times_last = {p: 0 for p in pids}
+    lateness = {p: [] for p in pids}
+    for (name, i), by_p in groups.items():
+        if set(by_p) != set(pids):
+            # a process died (or went silent) before this boundary: real
+            # finding on a preempted run, merge-contract violation on a
+            # clean one — counted either way, skewless
+            incomplete += 1
+            continue
+        arrivals = {p: by_p[p]["arrival"] for p in pids}
+        first = min(arrivals.values())
+        straggler = max(pids, key=lambda p: arrivals[p])
+        for p in pids:
+            lateness[p].append(arrivals[p] - first)
+        times_last[straggler] += 1
+        skew_table.append({
+            "name": name, "index": i, "step": by_p[ref]["step"],
+            "t_s": round(first, 6),
+            "skew_s": round(arrivals[straggler] - first, 6),
+            "straggler": straggler,
+            "arrivals_s": {str(p): round(arrivals[p], 6) for p in pids},
+        })
+    skew_table.sort(key=lambda r: r["t_s"])
+    ranking = sorted(
+        (
+            {
+                "process": p,
+                "times_last": times_last[p],
+                "boundaries": len(lateness[p]),
+                "mean_lateness_s": round(
+                    sum(lateness[p]) / len(lateness[p]), 6
+                ) if lateness[p] else 0.0,
+            }
+            for p in pids
+        ),
+        key=lambda r: (-r["times_last"], -r["mean_lateness_s"]),
+    )
+
+    non_ref = pids[1:]
+    residuals = [alignments[p]["residual_s"] for p in non_ref]
+    aligned_ok = all(
+        alignments[p]["n_anchors"] >= 2
+        and alignments[p]["residual_s"] is not None
+        and alignments[p]["residual_s"] <= residual_tol_s
+        for p in non_ref
+    )
+    collective_match_ok = incomplete == 0
+    consistency = {
+        "n_processes": len(pids),
+        "aligned_ok": bool(aligned_ok),
+        "max_residual_s": max([r for r in residuals if r is not None],
+                              default=0.0),
+        "residual_tol_s": residual_tol_s,
+        "attribution_ok": bool(attribution_ok),
+        "collective_match_ok": bool(collective_match_ok),
+        "incomplete_boundaries": incomplete,
+        # the gate bit: timelines really merged (every non-ref process
+        # anchored to sub-tolerance), every per-process attribution holds,
+        # every collective boundary is whole, and a multi-process merge
+        # produced at least one skew observation (none = the fleet
+        # instrumentation was silently dead)
+        "ok": bool(
+            aligned_ok and attribution_ok and collective_match_ok
+            and (len(pids) == 1 or len(skew_table) > 0)
+        ),
+    }
+    return {
+        "processes": processes,
+        "skew_table": skew_table,
+        "straggler_ranking": ranking,
+        "consistency": consistency,
+    }
+
+
+def fleet_chrome_trace(events_by_process, report):
+    """The merged Chrome trace: every process's records mapped onto the
+    reference clock (its fitted alignment), ``pid`` = process index, the
+    whole fleet shifted so the earliest record sits at t=0 (Chrome/Perfetto
+    dislike negative timestamps)."""
+    aligned = {}
+    t0 = None
+    for p, events in sorted(events_by_process.items()):
+        al = report["processes"][str(p)]["alignment"]
+        evs = []
+        for e in events:
+            e2 = dict(e, ts=_aligned(al, e["ts"]))
+            if "dur" in e2:
+                e2["dur"] = e2["dur"] * al["scale"]
+            evs.append(e2)
+            t0 = e2["ts"] if t0 is None else min(t0, e2["ts"])
+        aligned[p] = evs
+    out = {"traceEvents": [], "displayTimeUnit": "ms"}
+    for p, evs in sorted(aligned.items()):
+        trace = chrome_trace_from_events(
+            [dict(e, ts=e["ts"] - t0) for e in evs], process_index=p
+        )
+        out["traceEvents"].extend(trace["traceEvents"])
+    return out
+
+
+def render_fleet_table(report, max_rows=12):
+    lines = []
+    rows = [("process", "events", "anchors", "scale", "offset_s",
+             "residual_s", "attribution")]
+    for p, info in sorted(report["processes"].items(), key=lambda kv: int(kv[0])):
+        al = info["alignment"]
+        res = al["residual_s"]
+        rows.append((
+            p, str(info["n_events"]), str(info["n_anchors"]),
+            f"{al['scale']:.9g}", f"{al['offset_s']:.6f}",
+            "-" if res is None else f"{res:.6f}",
+            "ok" if info["attribution_ok"] else "FAILED",
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines += ["  ".join(c.ljust(w) for c, w in zip(r, widths)) for r in rows]
+    lines.insert(1, "-" * len(lines[0]))
+    table = sorted(report["skew_table"], key=lambda r: -r["skew_s"])[:max_rows]
+    if table:
+        lines.append(f"boundary skew (top {len(table)} by skew):")
+        for r in table:
+            lines.append(
+                f"  {r['name']}[{r['index']}] step={r['step']} "
+                f"t={r['t_s']:.3f}s skew={r['skew_s'] * 1e3:.1f}ms "
+                f"straggler=p{r['straggler']}"
+            )
+    for r in report["straggler_ranking"]:
+        if r["boundaries"]:
+            lines.append(
+                f"straggler ranking: p{r['process']} last at "
+                f"{r['times_last']}/{r['boundaries']} boundaries "
+                f"(mean lateness {r['mean_lateness_s'] * 1e3:.1f}ms)"
+            )
+    cons = report["consistency"]
+    if not cons["ok"]:
+        lines.append(f"CONSISTENCY: FAILED ({cons})")
+    return "\n".join(lines)
+
+
+def build_fleet_output(run_dir, session_reports):
+    """The committed fleet artifact (pure; schema pinned by tests):
+    one report per recorder session, ``ok`` = every session merged
+    consistently."""
+    return {
+        "schema": FLEET_SCHEMA,
+        "run_dir": run_dir,
+        "sessions": session_reports,
+        "ok": bool(session_reports) and all(
+            rep["consistency"]["ok"] for rep in session_reports.values()
+        ),
+    }
+
+
+def run_fleet(args):
+    sessions = tracing.discover_fleet_sessions(args.fleet)
+    if not sessions:
+        print(f"no events*.jsonl sessions in {args.fleet}")
+        return 1
+    reports = {}
+    last = None
+    for label, files in sessions.items():
+        # EVERY discovered process file enters the merge, records or not: a
+        # process whose file exists but holds zero complete records (a
+        # SIGKILL before its first full line) is exactly the dead-process
+        # post-mortem this mode exists to surface — silently dropping it
+        # would let a 2-process session merge "consistently" as one
+        events_by_process = {
+            pidx: load_events(path) for pidx, path in sorted(files.items())
+        }
+        report = build_fleet_report(events_by_process)
+        report["files"] = {
+            str(p): os.path.basename(files[p]) for p in events_by_process
+        }
+        reports[label] = report
+        last = (events_by_process, report)
+        print(f"== session {label} "
+              f"({report['consistency']['n_processes']} process(es)) ==")
+        print(render_fleet_table(report))
+    artifact = build_fleet_output(args.fleet, reports)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"wrote {args.json}")
+    if args.trace and last is not None:
+        # the merged Chrome trace of the LATEST session (the one a
+        # post-mortem usually wants — earlier sessions stay per-process)
+        with open(args.trace, "w") as f:
+            json.dump(fleet_chrome_trace(*last), f)
+        print(f"wrote {args.trace}")
+    return 0 if artifact["ok"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--events", required=True,
+    ap.add_argument("--events", default="",
                     help="a flight-recorder events.jsonl (run dir artifact)")
+    ap.add_argument("--fleet", default="", metavar="RUN_DIR",
+                    help="fleet mode: merge every per-process "
+                         "events*_p*.jsonl session in this run dir "
+                         "(clock-anchor alignment, skew table, straggler "
+                         "ranking)")
     ap.add_argument("--json", default="",
-                    help="write the attribution artifact here")
+                    help="write the attribution/fleet artifact here")
+    ap.add_argument("--trace", default="",
+                    help="(fleet) write the merged Chrome trace here")
     args = ap.parse_args(argv)
+    if bool(args.events) == bool(args.fleet):
+        ap.error("exactly one of --events / --fleet is required")
 
+    if args.fleet:
+        return run_fleet(args)
     report = build_report(load_events(args.events))
     print(render_table(report))
     if args.json:

@@ -193,6 +193,7 @@ def _agree_across_processes(local_ok: bool) -> bool:
     collective in the common case.
     """
     if jax.process_count() == 1:
+        tracing.clock_anchor("placement")
         return local_ok
     from jax.experimental import multihost_utils
 
@@ -205,6 +206,11 @@ def _agree_across_processes(local_ok: bool) -> bool:
         flags = multihost_utils.process_allgather(
             np.asarray([local_ok], np.int32)
         )
+    # the startup alignment ruler: every process just left the same
+    # collective, so this stamp is the same physical instant on each
+    # host's clock (trace_report --fleet; identical call count per
+    # resolution is this function's documented invariant)
+    tracing.clock_anchor("placement")
     return bool(np.asarray(flags).all())
 
 

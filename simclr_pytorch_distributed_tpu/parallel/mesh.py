@@ -96,7 +96,11 @@ def broadcast_from_main(s: str, max_len: int = 512) -> str:
         raise ValueError(f"string too long to broadcast ({len(raw)} > {max_len})")
     buf[: len(raw)] = np.frombuffer(raw, np.uint8)
     out = multihost_utils.broadcast_one_to_all(buf)
-    return bytes(np.asarray(out)).rstrip(b"\x00").decode()
+    # cast by VALUE, not raw memory: the broadcast can return the uint8
+    # payload in a widened dtype (observed with gloo CPU collectives),
+    # and bytes() of that buffer interleaves every char with nulls
+    out = np.asarray(out).astype(np.uint8)
+    return out.tobytes().rstrip(b"\x00").decode()
 
 
 def sync_processes(tag: str) -> None:

@@ -91,6 +91,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "watchdog stall dump appears (0 = observe only). "
                         "Set well above the first-step compile AND the "
                         "trainer's own --watchdog_secs")
+    p.add_argument("--straggler_skew_secs", type=float, default=1.0,
+                   help="boundary-skew bar for the WARN-ONLY straggler "
+                        "finding scraped off the child's "
+                        "train_boundary_skew_seconds gauge (0 = off); "
+                        "recorded to the supervisor timeline, never a kill")
     p.add_argument("--grace_secs", type=float, default=20.0,
                    help="SIGTERM->SIGKILL window on a supervisor-initiated "
                         "kill (the preemption machinery's chance to save)")
@@ -136,6 +141,7 @@ def main(argv=None) -> int:
         backoff_max_s=args.backoff_max_s,
         poll_s=args.poll_secs,
         stall_secs=args.stall_secs,
+        straggler_skew_secs=args.straggler_skew_secs,
         grace_secs=args.grace_secs,
         metrics_port=args.metrics_port,
         metrics_host=args.metrics_host,

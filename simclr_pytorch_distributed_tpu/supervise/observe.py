@@ -26,11 +26,14 @@ host-only process that must never touch the accelerator its child needs.
 from __future__ import annotations
 
 import glob
-import json
 import os
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Tuple
+
+# the shared torn-line-tolerant jsonl reader (tracing imports no jax at
+# module level — the supervisor stays a host-only process)
+from simclr_pytorch_distributed_tpu.utils.tracing import parse_jsonl
 
 # recorder event names the watcher surfaces to the supervisor (the trainer
 # emits them on its side: utils/guard.py HealthMonitor, train/*.py)
@@ -82,6 +85,38 @@ class MetricsScraper:
             return None
 
 
+# the fleet-skew gauges the trainer sidecar exposes: utils/telemetry.py
+# stamps them at each flush-boundary failure-code allgather (the skew is
+# the spread of per-host waits piggybacked on that collective)
+SKEW_GAUGE = "train_boundary_skew_seconds"
+WAIT_GAUGE = "train_collective_wait_seconds"
+
+
+def straggler_finding(
+    gauges: Optional[Dict[str, float]], skew_bar_s: float
+) -> Optional[dict]:
+    """A WARN-ONLY straggler observation from one sidecar scrape, or None.
+
+    Fires when ``train_boundary_skew_seconds`` (the fleet's boundary
+    arrival spread) is at/above ``skew_bar_s``: some process is
+    consistently late to the collectives and the whole synchronous step is
+    paced by it. The supervisor RECORDS the finding (who/when/how much)
+    but takes no action — today's policy table has no straggler remedy
+    (resize away from the slow host, re-shard, abort); the recorded
+    finding is the input a future policy row can act on, the same way
+    stall dumps preceded the liveness-kill row."""
+    if not gauges or skew_bar_s <= 0:
+        return None
+    skew = gauges.get(SKEW_GAUGE)
+    if skew is None or skew < skew_bar_s:
+        return None
+    finding = {"skew_s": skew, "bar_s": skew_bar_s}
+    for key, name in ((WAIT_GAUGE, "wait_s"), ("train_step", "step")):
+        if key in gauges:
+            finding[name] = gauges[key]
+    return finding
+
+
 class RunDirWatcher:
     """Incremental view of one trainer run dir.
 
@@ -124,13 +159,11 @@ class RunDirWatcher:
                 continue
             # only consume COMPLETE lines: the trainer appends+flushes per
             # record, but a poll can still race the write mid-line
-            consumed = chunk.rfind("\n") + 1
+            # (tracing.parse_jsonl — the one shared torn-line-tolerant
+            # reader, also behind trace_report/health_report)
+            records, consumed = parse_jsonl(chunk)
             self._offsets[path] = offset + consumed
-            for line in chunk[:consumed].splitlines():
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
+            for rec in records:
                 if rec.get("name") in WATCHED_EVENTS:
                     rec["_file"] = os.path.basename(path)
                     events.append(rec)

@@ -59,6 +59,10 @@ class SuperviseConfig:
     backoff_max_s: float = 60.0
     poll_s: float = 1.0
     stall_secs: float = 0.0          # 0 = no liveness-kill (observe only)
+    # boundary-skew bar (s) for the WARN-ONLY straggler finding scraped off
+    # the child's train_boundary_skew_seconds gauge; 0 = off. Never a kill:
+    # the recorded finding is the input a future policy row can act on.
+    straggler_skew_secs: float = 1.0
     grace_secs: float = 20.0         # SIGTERM -> SIGKILL window
     metrics_port: int = 0            # the CHILD's sidecar port; 0 = no scrape
     metrics_host: str = "127.0.0.1"
@@ -125,6 +129,13 @@ class Supervisor:
         # default action would orphan the trainer with no grace window and
         # lose the emergency save the whole preempt contract promises)
         self._terminate: Optional[int] = None
+        # last raw sidecar scrape (the straggler finding reads the skew
+        # gauges off the SAME scrape liveness used — one GET per poll) and
+        # the last step a straggler finding was recorded at (the skew
+        # gauge holds its value between boundaries; re-recording it every
+        # poll would spam the supervisor timeline)
+        self._last_scrape: Optional[dict] = None
+        self._straggler_step: Optional[float] = None
 
     # ------------------------------------------------------------- channels
     def _handle_signal(self, signum, frame):  # noqa: ARG002 — handler signature
@@ -187,8 +198,10 @@ class Supervisor:
         None when unavailable (sidecar down/not up yet) or not yet beating
         (the gauge's -1 sentinel during the first-step compile)."""
         if self.scraper is None:
+            self._last_scrape = None
             return None
         gauges = self.scraper.scrape()
+        self._last_scrape = gauges
         if gauges is None:
             return None
         age = gauges.get("train_last_boundary_age_seconds")
@@ -316,6 +329,23 @@ class Supervisor:
                 )
                 return rc, False, stall_dumps, health_alarms
             age = self._liveness_age()
+            finding = observe.straggler_finding(
+                self._last_scrape, cfg.straggler_skew_secs
+            )
+            if finding is not None and finding.get("step") != self._straggler_step:
+                # WARN-ONLY: recorded for the post-mortem (and a future
+                # policy row), never a kill — a straggling pod is slow,
+                # not wedged; once per boundary step, not per poll
+                self._straggler_step = finding.get("step")
+                self.recorder.event(
+                    "straggler_finding", track="supervisor", **finding
+                )
+                logger.warning(
+                    "straggler finding: boundary skew %.3fs >= %.3fs "
+                    "(step %s) — recorded, no action",
+                    finding["skew_s"], finding["bar_s"],
+                    finding.get("step"),
+                )
             stalled = bool(
                 cfg.stall_secs > 0
                 and ((age is not None and age >= cfg.stall_secs)

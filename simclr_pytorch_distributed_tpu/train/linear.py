@@ -292,15 +292,32 @@ def run(cfg: config_lib.LinearConfig):
         process_count=jax.process_count(),
     )
     steps_per_epoch = len(loader)
+    # observability stack (docs/OBSERVABILITY.md, utils/obs.py): flight
+    # recorder -> <save_folder>/events.jsonl (+ trace.json), stall
+    # watchdog on the flush boundary, optional Prometheus sidecar. Built
+    # BEFORE the store: placement resolution is the run's first
+    # collective, and its span + startup clock anchor (the fleet report's
+    # alignment ruler) must land on the record.
+    obs = RunObservability(cfg, name="linear")
     # --data_placement (data/device_store.py): 'device' keeps the train set
     # HBM-resident, 'window' streams a double-buffered window — the probe
     # step is SMALL, so the per-step H2D was a proportionally bigger slice
     # of its loop than the pretrain driver's
-    store = device_store.make_store(
-        cfg.data_placement, loader, mesh,
-        budget_bytes=device_store.budget_override_bytes(cfg.device_budget_mb),
-        window_batches=cfg.data_window_batches,
-    )
+    try:
+        store = device_store.make_store(
+            cfg.data_placement, loader, mesh,
+            budget_bytes=device_store.budget_override_bytes(cfg.device_budget_mb),
+            window_batches=cfg.data_window_batches,
+        )
+    except BaseException as e:
+        # the placement rejection (an explicit --data_placement the
+        # budget/ladder refuses) is a DESIGNED raise path that sits
+        # before the driver's main try/finally: close the stack here
+        # so the recorder still exports and the terminal exit code
+        # stamps (the startup-failure post-mortem the stack exists for)
+        obs.close(exit_code=exit_code_for(e))
+        raise
+    obs.staged()  # staging done: reset the watchdog deadline (utils/obs.py)
 
     # encoder variables from the pretrain checkpoint (main_linear.py:125-142)
     dtype = jnp.bfloat16 if cfg.bf16 else jnp.float32
@@ -324,10 +341,6 @@ def run(cfg: config_lib.LinearConfig):
     )
     mean, std = stats_for(cfg.dataset)
     aug_cfg = AugmentConfig(size=cfg.size, mean=mean, std=std, color_ops=False)
-    # observability stack (docs/OBSERVABILITY.md, utils/obs.py): flight
-    # recorder -> <save_folder>/events.jsonl (+ trace.json), stall
-    # watchdog on the flush boundary, optional Prometheus sidecar
-    obs = RunObservability(cfg, name="linear")
     # device-side metric ring + background flush (utils/telemetry.py): the
     # probe step is SMALL, so the per-window sync flush was a proportionally
     # bigger slice of its loop than the pretrain driver's

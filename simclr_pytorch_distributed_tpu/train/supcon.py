@@ -560,16 +560,37 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         process_count=jax.process_count(),
     )
     steps_per_epoch = len(loader)
+    # Observability stack (docs/OBSERVABILITY.md, utils/obs.py): the flight
+    # recorder writes host-boundary spans to <save_folder>/events.jsonl
+    # (+ a Chrome-trace export on close), the stall watchdog turns a
+    # non-advancing flush boundary into stack-dump artifacts, and the
+    # optional Prometheus sidecar exposes liveness gauges. All host-only:
+    # the dispatch-only hot loop gains zero device syncs or transfers
+    # (asserted mechanically in tests/test_tracing.py). Built BEFORE the
+    # store: placement resolution is the run's FIRST collective, and its
+    # placement_decision span + startup clock anchor (the fleet report's
+    # alignment ruler, trace_report --fleet) must land on the record.
+    obs = RunObservability(cfg, name="supcon")
     # --data_placement: 'device' keeps the uint8 dataset HBM-resident,
     # 'window' streams a double-buffered window (one H2D per window), and
     # 'auto' walks the device->window->host ladder against the budget
     # (--device_budget_mb overrides it) with a startup banner naming any
     # degradation (data/device_store.py)
-    store = device_store.make_store(
-        cfg.data_placement, loader, mesh,
-        budget_bytes=device_store.budget_override_bytes(cfg.device_budget_mb),
-        window_batches=cfg.data_window_batches,
-    )
+    try:
+        store = device_store.make_store(
+            cfg.data_placement, loader, mesh,
+            budget_bytes=device_store.budget_override_bytes(cfg.device_budget_mb),
+            window_batches=cfg.data_window_batches,
+        )
+    except BaseException as e:
+        # the placement rejection (an explicit --data_placement the
+        # budget/ladder refuses) is a DESIGNED raise path that sits
+        # before the driver's main try/finally: close the stack here
+        # so the recorder still exports and the terminal exit code
+        # stamps (the startup-failure post-mortem the stack exists for)
+        obs.close(exit_code=exit_code_for(e))
+        raise
+    obs.staged()  # staging done: reset the watchdog deadline (utils/obs.py)
     model, schedule, tx, state, step_cfg = build(cfg, steps_per_epoch, mesh.size)
     logging.info("contrastive loss impl: %s", step_cfg.loss_impl)
     # --recipe: the SSL loss head + its TrainState slots (recipes/). Attach
@@ -631,14 +652,6 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         )
 
     aug_cfg = make_augment_config(cfg)
-    # Observability stack (docs/OBSERVABILITY.md, utils/obs.py): the flight
-    # recorder writes host-boundary spans to <save_folder>/events.jsonl
-    # (+ a Chrome-trace export on close), the stall watchdog turns a
-    # non-advancing flush boundary into stack-dump artifacts, and the
-    # optional Prometheus sidecar exposes liveness gauges. All host-only:
-    # the dispatch-only hot loop gains zero device syncs or transfers
-    # (asserted mechanically in tests/test_tracing.py).
-    obs = RunObservability(cfg, name="supcon")
     # One telemetry session per run: the device-side metric ring (written
     # inside the jitted update) + the background flush executor the epoch
     # loop hands each print_freq window to (utils/telemetry.py). The

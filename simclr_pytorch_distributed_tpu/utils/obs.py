@@ -87,6 +87,15 @@ class RunObservability:
         if cfg.metrics_port:
             self.gauges = prom.TrainerGauges()
             self.gauges.register("checkpoint_pending_saves", pending_saves)
+            if self.recorder is not None:
+                # records evicted from the recorder's bounded in-memory
+                # ring (trace.json / watchdog snapshots truncated; the
+                # jsonl keeps all) — a saturated recorder must be an
+                # operator-visible signal, not a silent loss
+                rec = self.recorder
+                self.gauges.register(
+                    "recorder_dropped_records", lambda: rec.dropped
+                )
             self.sidecar = prom.start_metrics_server(
                 cfg.metrics_port, self.gauges.prometheus_text,
                 host=getattr(cfg, "metrics_host", "127.0.0.1"),
@@ -99,6 +108,18 @@ class RunObservability:
     def set_epoch(self, epoch: int) -> None:
         if self.gauges is not None:
             self.gauges.set(epoch=epoch)
+
+    def staged(self) -> None:
+        """Call right after ``make_store`` returns. The stack is built
+        BEFORE placement resolution (so the placement collective — a real
+        deadlock candidate — runs under the armed watchdog and its span
+        lands on the record), but the store's one-time dataset upload can
+        be large: without this beat that staging time would eat into the
+        first flush-boundary deadline, which ``--watchdog_secs`` is only
+        documented to cover from compile onward (a spurious staging dump
+        would be read by the supervisor as a stall)."""
+        if self.watchdog is not None:
+            self.watchdog.beat()
 
     def close(self, exit_code: int = None) -> None:
         """Teardown, last in the driver's ``finally`` (after the final

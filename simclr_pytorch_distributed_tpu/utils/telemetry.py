@@ -214,6 +214,10 @@ class TelemetrySession:
         # a wedged collective/device rather than ordinary slowness
         self._watchdog = watchdog
         self._gauges = gauges
+        # this host's wait inside the PREVIOUS boundary's failure-code
+        # allgather (ms), piggybacked on the next one (fleet skew; -1 =
+        # nothing yet): see check_failures_global
+        self._last_wait_ms = -1
 
     # ring pass-throughs used by the drivers
     def init_buffer(self, sharding=None):
@@ -414,14 +418,52 @@ class TelemetrySession:
             import numpy as np
             from jax.experimental import multihost_utils
 
+            # The allgather payload carries TWO int32s per host: the
+            # failure code, plus this host's wait (ms) inside the PREVIOUS
+            # boundary's allgather — widening an EXISTING collective, not
+            # adding one (the zero-sync discipline). Every host then knows
+            # the whole fleet's last-boundary waits: for a synchronous
+            # collective each host's wait ≈ (last arrival − its own
+            # arrival) + network, so the spread max(wait) − min(wait) is
+            # the fleet's ARRIVAL skew and the host that waited LEAST is
+            # the straggler (it arrived last; everyone else was parked on
+            # it). One boundary stale by construction — the NaN-detection
+            # latency convention. The span's own ts/dur are this host's
+            # arrival/wait for the offline fleet report.
+            prev_wait = self._last_wait_ms
+            t_arrive = time.monotonic()
             with tracing.span(
                 "failure_code_allgather", track="main:collective",
                 step=step_hint, local_code=code,
             ):
-                codes = multihost_utils.process_allgather(
-                    np.asarray([code], np.int32)
+                gathered = multihost_utils.process_allgather(
+                    np.asarray([code, prev_wait], np.int32)
                 )
-            code = int(np.asarray(codes).max())
+            wait_s = time.monotonic() - t_arrive
+            self._last_wait_ms = min(int(round(wait_s * 1e3)), 2**31 - 1)
+            gathered = np.asarray(gathered).reshape(-1, 2)
+            code = int(gathered[:, 0].max())
+            if self._gauges is not None:
+                self._gauges.set(collective_wait_seconds=wait_s)
+            waits = gathered[:, 1]
+            if len(waits) > 1 and (waits >= 0).all():
+                skew_s = float(waits.max() - waits.min()) / 1e3
+                if self._gauges is not None:
+                    self._gauges.set(boundary_skew_seconds=skew_s)
+                tracing.event(
+                    "boundary_skew", track=tracing.FLEET_TRACK,
+                    step=step_hint, skew_s=round(skew_s, 6),
+                    straggler=int(waits.argmin()),
+                )
+        elif self._gauges is not None:
+            # single process: no peers to wait on — publish the keys so a
+            # scraper's dashboard reads 0, not absent
+            self._gauges.set(
+                collective_wait_seconds=0.0, boundary_skew_seconds=0.0
+            )
+        # the matched instant every process just left (or, single-process,
+        # a plain deterministic stamp): the fleet report's alignment ruler
+        tracing.clock_anchor("flush_boundary", step=step_hint)
         if code == 0:
             return
         # the recorder is exactly for this moment: a post-mortem must show

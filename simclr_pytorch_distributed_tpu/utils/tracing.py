@@ -49,10 +49,11 @@ import faulthandler
 import json
 import logging
 import os
+import re
 import threading
 import time
 from collections import deque
-from typing import Callable, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
@@ -60,6 +61,10 @@ logger = logging.getLogger(__name__)
 MAIN_TRACK_PREFIX = "main:"
 # the envelope track excluded from attribution (it CONTAINS the others)
 EPOCH_TRACK = "main:epoch"
+# the fleet track: clock anchors + per-boundary skew observations — the
+# records scripts/trace_report.py --fleet aligns multi-process timelines on
+FLEET_TRACK = "fleet"
+ANCHOR_EVENT = "clock_anchor"
 
 
 
@@ -92,6 +97,7 @@ class FlightRecorder:
         self._closed = False
         self.process_index = int(process_index)
         self.dropped = 0  # records lost to the ring bound (jsonl keeps all)
+        self._anchor_seq = 0  # clock_anchor sequence (see clock_anchor)
 
     # ------------------------------------------------------------ record
     def _emit(self, rec: dict) -> None:
@@ -128,6 +134,30 @@ class FlightRecorder:
         if attrs:
             rec["args"] = attrs
         self._emit(rec)
+
+    def clock_anchor(self, kind: str, **attrs) -> int:
+        """Record a fleet clock anchor and return its sequence number.
+
+        Anchors are stamped at ALREADY-MATCHED collective points (the
+        startup placement agreement, each flush-boundary failure-code
+        allgather) right AFTER the collective releases — on a pod every
+        process leaves the allgather at (approximately) the same real
+        instant, so anchor ``seq`` k is the same physical moment observed
+        through each process's unaligned monotonic clock. That makes the
+        per-process ``(seq, ts)`` pairs an alignment ruler:
+        ``scripts/trace_report.py --fleet`` fits one affine map per process
+        over them and merges the timelines. The sequence is deterministic
+        because the collective call SCHEDULE is (the documented invariant
+        of those call sites — a mismatched count is already a deadlock).
+        Single-process runs record the same events (host-only, zero device
+        cost); they simply carry no cross-process information.
+        """
+        with self._lock:
+            self._anchor_seq += 1
+            seq = self._anchor_seq
+        self.event(ANCHOR_EVENT, track=FLEET_TRACK, kind=kind, anchor=seq,
+                   **attrs)
+        return seq
 
     def record_span(
         self, name: str, track: str, start: float, end: float, **attrs
@@ -190,6 +220,13 @@ class FlightRecorder:
         with self._lock:
             if self._closed:
                 return
+        if self.dropped:
+            # a saturated ring means trace.json and watchdog snapshots are
+            # truncated (the jsonl keeps everything): leave the count on
+            # the durable record so trace_report can flag it as a finding
+            self.event(
+                "recorder_dropped", track="events", records=self.dropped
+            )
         try:
             self.export_chrome_trace()
         except OSError as e:  # disk full on the way out: keep the exit clean
@@ -241,6 +278,84 @@ def chrome_trace_from_events(events: Iterable[dict], process_index: int = 0) -> 
 
 EVENTS_FILE = "events.jsonl"
 TRACE_FILE = "trace.json"
+
+# events[_pN][_rK].jsonl — process N (absent = 0), session K (absent = 1)
+EVENTS_FILE_RE = re.compile(r"^(events(?:_p(\d+))?)(?:_r(\d+))?\.jsonl$")
+
+
+def parse_jsonl(text: str) -> Tuple[List[dict], int]:
+    """Parse recorder jsonl text into ``(records, consumed)``.
+
+    The ONE torn-line-tolerant reader behind ``load_events_jsonl``,
+    ``scripts/trace_report.py``, ``scripts/health_report.py``, and the
+    supervisor's ``RunDirWatcher``: only COMPLETE lines (through the last
+    newline) are consumed — the half-written final line a SIGKILL (or a
+    reader racing the writer) leaves behind is exactly the run the
+    recorder exists to diagnose, so it must never crash the reader.
+    Complete-but-corrupt lines are skipped, not raised. ``consumed`` is
+    the offset just past the last newline — the incremental-tail
+    bookkeeping the watcher keeps per file.
+    """
+    consumed = text.rfind("\n") + 1
+    records: List[dict] = []
+    for line in text[:consumed].splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            records.append(rec)
+    return records, consumed
+
+
+def load_events_jsonl(path: str) -> List[dict]:
+    """All complete records of one recorder jsonl (torn-line tolerant)."""
+    with open(path) as f:
+        return parse_jsonl(f.read())[0]
+
+
+def session_files_for(events_path: str) -> List[str]:
+    """Every session file of the PROCESS ``events_path`` belongs to, in
+    session order: ``events.jsonl``, ``events_r2.jsonl``, ... (or the
+    ``events_pN*`` family). A resumed run rotates to a fresh ``_rK`` file
+    per session (:func:`run_paths`), so a reader that stops at the first
+    file silently truncates the timeline at the first preemption. Unknown
+    file names return just themselves."""
+    d, fname = os.path.split(events_path)
+    m = EVENTS_FILE_RE.match(fname)
+    if not m:
+        return [events_path]
+    base = m.group(1)
+    out = []
+    k = 1
+    while True:
+        name = f"{base}.jsonl" if k == 1 else f"{base}_r{k}.jsonl"
+        path = os.path.join(d, name)
+        if not os.path.exists(path):
+            break
+        out.append(path)
+        k += 1
+    return out or [events_path]
+
+
+def discover_fleet_sessions(run_dir: str) -> Dict[str, Dict[int, str]]:
+    """All recorder sessions in a run dir, grouped for the fleet view:
+    ``{"r1": {0: ".../events.jsonl", 1: ".../events_p1.jsonl"}, "r2": ...}``
+    — one entry per session, mapping process index -> that process's
+    events file. Sessions align only within themselves (timestamps restart
+    per session), so the fleet report merges each session independently."""
+    sessions: Dict[int, Dict[int, str]] = {}
+    for fname in sorted(os.listdir(run_dir)):
+        m = EVENTS_FILE_RE.match(fname)
+        if not m:
+            continue
+        pidx = int(m.group(2) or 0)
+        k = int(m.group(3) or 1)
+        sessions.setdefault(k, {})[pidx] = os.path.join(run_dir, fname)
+    return {f"r{k}": files for k, files in sorted(sessions.items())}
 
 
 def run_paths(run_dir: str, process_index: int = 0):
@@ -322,6 +437,15 @@ def event(name: str, track: str = "events", **attrs) -> None:
     rec = _current
     if rec is not None:
         rec.event(name, track, **attrs)
+
+
+def clock_anchor(kind: str, **attrs) -> Optional[int]:
+    """Record a fleet clock anchor on the installed recorder (no-op
+    ``None`` without one) — see :meth:`FlightRecorder.clock_anchor`."""
+    rec = _current
+    if rec is None:
+        return None
+    return rec.clock_anchor(kind, **attrs)
 
 
 def record_span(name: str, track: str, start: float, end: float, **attrs) -> None:

@@ -35,6 +35,13 @@ if ndev_local > 1:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+if nproc > 1:
+    # cross-process CPU collectives need the gloo implementation selected
+    # BEFORE the backend is created — without it every multi-process jit
+    # dies with "Multiprocess computations aren't implemented on the CPU
+    # backend" (the env-var spelling does not reach this flag on this
+    # jax/jaxlib, so it must be a config update here)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
 cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
 if cache_dir:
     jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache_dir))
@@ -76,6 +83,26 @@ if mode in ("driver", "driver_partial", "ce"):
         sys.exit(0)
 
     from simclr_pytorch_distributed_tpu.train import supcon as supcon_driver
+
+    # fleet-evidence hook (docs/evidence/fleet_report_r13.json): make ONE
+    # process a deliberate straggler by delaying its arrival at every
+    # flush-boundary failure-code allgather — the injected skew must show
+    # up in trace_report --fleet's skew table with this process named
+    straggler_ms = float(os.environ.get("FLEET_STRAGGLER_MS", "0") or 0)
+    if straggler_ms and pid == int(os.environ.get("FLEET_STRAGGLER_PID", "1")):
+        import time as _time
+
+        from simclr_pytorch_distributed_tpu.utils.telemetry import (
+            TelemetrySession,
+        )
+
+        _orig_check = TelemetrySession.check_failures_global
+
+        def _late_check(self, step_hint=0):
+            _time.sleep(straggler_ms / 1e3)
+            return _orig_check(self, step_hint)
+
+        TelemetrySession.check_failures_global = _late_check
 
     epochs = int(sys.argv[6]) if len(sys.argv) > 6 else 2
     resume = sys.argv[7] if len(sys.argv) > 7 else ""

@@ -843,6 +843,125 @@ def test_trace_report_gate_record():
     assert not r["ok"] and "flush-boundary" in r["error"]
 
 
+def _fleet_session(run_dir, suffix="", scale=1.02, offset=5.0, late=0.4,
+                   n_boundaries=3):
+    """Write one recorder SESSION as two virtual processes: p0 on the
+    reference clock, p1 on a rate-drifted + offset clock, arriving
+    ``late`` seconds after p0 at every collective (the straggler)."""
+    p0, p1 = [], []
+    anchor = 0
+
+    def boundary(name, kind, T, step=None):
+        nonlocal anchor
+        anchor += 1
+        a0, a1 = T - late - 0.05, T - 0.05
+        args = {"step": step} if step is not None else {}
+        p0.append(_span(name, "main:collective", a0, T - a0, **args))
+        p1.append(_span(name, "main:collective", scale * a1 + offset,
+                        scale * (T - a1), **args))
+        p0.append(_instant("clock_anchor", "fleet", T,
+                           kind=kind, anchor=anchor))
+        p1.append(_instant("clock_anchor", "fleet", scale * T + offset,
+                           kind=kind, anchor=anchor))
+
+    boundary("placement_decision", "placement", 1.0)
+    for k in range(n_boundaries):
+        boundary("failure_code_allgather", "flush_boundary",
+                 10.0 + 5.0 * k, step=2 * (k + 1))
+    p0.append(_span("flush_boundary", "main:flush", 2.0, 0.5, step=0))
+    p1.append(_span("flush_boundary", "main:flush", scale * 2.0 + offset,
+                    scale * 0.5, step=0))
+    names = {0: f"events{suffix}.jsonl", 1: f"events_p1{suffix}.jsonl"}
+    for pidx, events in ((0, p0), (1, p1)):
+        with open(os.path.join(run_dir, names[pidx]), "w") as f:
+            for e in sorted(events, key=lambda e: e["ts"]):
+                f.write(json.dumps(e) + "\n")
+
+
+def test_trace_report_fleet_cli_merges_two_virtual_processes(tmp_path):
+    """The tier-1 fleet smoke: a 2-virtual-process run dir (two per-process
+    events files on deliberately offset clocks, across TWO sessions) goes
+    through the real ``--fleet`` CLI — sessions discovered and merged,
+    anchors aligned to sub-tolerance residual, the injected straggler
+    named, one pid per process in the merged Chrome trace."""
+    tr = _load("trace_report")
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    _fleet_session(str(run_dir))
+    _fleet_session(str(run_dir), suffix="_r2", offset=-3.0, late=0.2)
+    # a torn tail on one file must not break the merge (SIGKILL session)
+    with open(run_dir / "events_p1_r2.jsonl", "a") as f:
+        f.write('{"half": ')
+    out = tmp_path / "fleet.json"
+    trace_out = tmp_path / "fleet_trace.json"
+    rc = tr.main(["--fleet", str(run_dir), "--json", str(out),
+                  "--trace", str(trace_out)])
+    assert rc == 0
+    artifact = json.load(open(out))
+    assert artifact["schema"] == "fleet_report/v1" and artifact["ok"]
+    assert sorted(artifact["sessions"]) == ["r1", "r2"]
+    for label, rep in artifact["sessions"].items():
+        cons = rep["consistency"]
+        assert cons["ok"] and cons["n_processes"] == 2
+        assert cons["max_residual_s"] <= tr.FLEET_RESIDUAL_TOL_S
+        assert rep["straggler_ranking"][0]["process"] == 1
+        assert all(r["straggler"] == 1 for r in rep["skew_table"])
+        assert rep["files"] == {
+            "0": "events.jsonl" if label == "r1" else "events_r2.jsonl",
+            "1": "events_p1.jsonl" if label == "r1"
+                 else "events_p1_r2.jsonl",
+        }
+    trace = json.load(open(trace_out))
+    pids = {e["pid"] for e in trace["traceEvents"]}
+    assert pids == {0, 1}
+
+
+def test_trace_report_fleet_cli_fails_on_recordless_process(tmp_path):
+    """Review fix, CLI level: a discovered per-process file with zero
+    complete records (dead-before-first-line process) must fail the merge
+    rather than shrink the session to one process and exit 0."""
+    tr = _load("trace_report")
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    _fleet_session(str(run_dir))
+    (run_dir / "events_p1.jsonl").write_text('{"torn": ')  # nothing complete
+    out = tmp_path / "fleet.json"
+    rc = tr.main(["--fleet", str(run_dir), "--json", str(out)])
+    assert rc == 1
+    artifact = json.load(open(out))
+    assert not artifact["ok"]
+    rep = artifact["sessions"]["r1"]
+    assert rep["consistency"]["n_processes"] == 2
+    assert rep["processes"]["1"]["n_events"] == 0
+
+
+def test_trace_report_flags_recorder_saturation():
+    tr = _load("trace_report")
+    events = _good_events() + [
+        _instant("recorder_dropped", "events", 99.0, records=12),
+    ]
+    report = tr.build_report(events)
+    joined = " ".join(a["flag"] for a in report["anomalies"])
+    assert "ring saturated" in joined
+
+
+def test_ratchet_fleet_and_ledger_in_default_gate_list():
+    ratchet = _load("ratchet")
+    assert ratchet.CONFIGS["fleet_report"]["kind"] == "fleet_report"
+    assert ratchet.CONFIGS["perf_ledger"]["kind"] == "perf_ledger"
+    # ...and the committed evidence artifacts they bind on exist and pass
+    repo = os.path.dirname(SCRIPTS)
+    with open(os.path.join(repo,
+                           ratchet.CONFIGS["fleet_report"]["artifact"])) as f:
+        fleet_artifact = json.load(f)
+    assert ratchet.fleet_gate_record(fleet_artifact)["ok"]
+    pl = _load("perf_ledger")
+    records = pl.load_ledger(
+        os.path.join(repo, ratchet.CONFIGS["perf_ledger"]["artifact"])
+    )
+    assert ratchet.ledger_gate_record(records)["ok"]
+
+
 def test_no_stale_pycache_for_deleted_modules():
     """A __pycache__ .pyc whose source module no longer exists (e.g. the
     once-stray serve/__pycache__/registry.cpython-310.pyc) advertises a
