@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Static invariant linter for the distributed contracts (docs/ANALYSIS.md).
+
+Checks the whole tree — the package, scripts/, and the root entry points —
+against the four load-bearing contracts, with stdlib ``ast`` only (no jax;
+runs in milliseconds on any box):
+
+1. collective-schedule: no host-level collective under a process-dependent
+   conditional / after a process-dependent early exit / inside an
+   exception-swallowing try (the split-verdict deadlock class);
+2. donation-safety: no read of a donated binding after the donating call
+   (the PR-1 use-after-donation class);
+3. hot-loop-sync: no sync-forcing host op inside jitted step functions or
+   the drivers' flush-boundary loops, except at `# sync-ok: <reason>`
+   annotated sites (the zero-sync contract, statically);
+4. contract-registry: metric-key tuples sorted+unique+single-sourced,
+   artifact schemas pinned to module constants, trainer flags agreeing
+   through the shared config.py registry.
+
+Designed matched points live in analysis/allowlist.py with recorded
+reasons; stale entries are findings too. Exit 0 = clean.
+
+Usage:
+    python scripts/invariant_lint.py            # human-readable, exit 0/1
+    python scripts/invariant_lint.py --json OUT # + the schema-pinned
+                                                # artifact ratchet gates on
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from simclr_pytorch_distributed_tpu.analysis import (  # noqa: E402
+    build_output,
+    run_lint,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None,
+                    help="write the invariant_lint/v1 artifact here")
+    ap.add_argument("--root", default=REPO,
+                    help="repo root to lint (default: this checkout)")
+    args = ap.parse_args(argv)
+
+    result = run_lint(args.root)
+    out = build_output(result)
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+
+    for f in result["findings"]:
+        print(f.render())
+    n_allow = sum(len(a["findings"]) for a in result["allowlisted"])
+    print(
+        f"invariant_lint: {len(result['findings'])} finding(s), "
+        f"{n_allow} allowlisted matched point(s), "
+        f"{result['files_scanned']} files scanned, "
+        f"rules: {', '.join(result['rules_run'])}"
+    )
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SCHEMA = "perf_ledger/v1"
+CHECK_SCHEMA = "perf_ledger_check/v1"  # the `check` CLI's own artifact
 DEFAULT_LEDGER = os.path.join("docs", "perf_ledger.jsonl")
 # every record must carry these (the pinned schema the ratchet gate checks)
 REQUIRED_KEYS = (
@@ -270,7 +271,7 @@ def detect_regression(records, fraction=REGRESSION_FRACTION,
 def build_check_output(ledger_path, records, verdicts):
     """The check artifact (pure; schema pinned by tests)."""
     return {
-        "schema": "perf_ledger_check/v1",
+        "schema": CHECK_SCHEMA,
         "ledger": ledger_path,
         "n_records": len(records),
         "schema_errors": schema_errors(records),

@@ -192,6 +192,18 @@ CONFIGS = {
     # list.
     "perf_ledger": dict(model=None, epochs=0, bar=None, kind="perf_ledger",
                         dataset=None, artifact="docs/perf_ledger.jsonl"),
+    # round 14: the static invariant-lint gate (docs/ANALYSIS.md). Runs
+    # scripts/invariant_lint.py over the tree — stdlib ast, no driver, no
+    # device — and binds on the pure lint_gate_record EVERYWHERE: zero
+    # unallowlisted findings against the four distributed contracts
+    # (collective-schedule, donation-safety, hot-loop-sync,
+    # contract-registry), every allowlist entry carrying a reason, all
+    # four rule families actually run. The contracts are properties of the
+    # SOURCE, so unlike the timing gates there is no device-kind skip path
+    # — a regression fails the gate on every device. Milliseconds, so it
+    # rides the default list.
+    "invariant_lint": dict(model=None, epochs=0, bar=None,
+                           kind="invariant_lint", dataset=None),
 }
 
 # CPU-calibrated bar for the health_report smoke's online probe: best
@@ -630,6 +642,64 @@ def fleet_gate_record(artifact):
     return record
 
 
+def lint_gate_record(artifact):
+    """Gate decision for one invariant_lint artifact (pure — tested
+    without running the linter).
+
+    Binds on EVERY device, hardware-independently (the trace_report
+    convention taken to its limit: the claims are properties of the
+    source tree, not of any run). Checks: the pinned schema; all four
+    rule families ran (a rule module silently dropped from the runner
+    must fail here, not pass); ZERO unallowlisted findings; and every
+    allowlisted matched point carrying a non-empty reason — the
+    allowlist is a registry of justified exceptions, not a mute button.
+    """
+    # jax-free: the analysis package is stdlib-ast only (the package
+    # parent re-exports pull jax, which this parent process may import
+    # but never drive — the bench-gate convention)
+    from simclr_pytorch_distributed_tpu.analysis import runner as lint_runner
+
+    record = {
+        "metric": "ratchet_invariant_lint",
+        "value": artifact.get("n_findings"),
+        "files_scanned": artifact.get("files_scanned"),
+        "rules_run": artifact.get("rules_run"),
+        "allowlisted": [
+            {"key": a.get("key"), "matched": len(a.get("findings", []))}
+            for a in artifact.get("allowlisted", [])
+        ],
+    }
+
+    def fail(msg):
+        record["ok"] = False
+        record["error"] = msg
+        return record
+
+    if artifact.get("schema") != lint_runner.SCHEMA:
+        return fail(f"unexpected schema {artifact.get('schema')!r}")
+    missing = sorted(
+        set(lint_runner.RULE_FAMILIES) - set(artifact.get("rules_run", []))
+    )
+    if missing:
+        return fail(f"rule families did not run: {missing}")
+    for entry in artifact.get("allowlisted", []):
+        if not str(entry.get("reason", "")).strip():
+            return fail(
+                f"allowlist entry {entry.get('key')!r} carries no reason"
+            )
+    findings = artifact.get("findings", [])
+    if findings or not artifact.get("ok"):
+        heads = "; ".join(
+            f"{f.get('file')}:{f.get('line')} [{f.get('rule')}]"
+            for f in findings[:5]
+        )
+        return fail(
+            f"{len(findings)} unallowlisted invariant finding(s): {heads}"
+        )
+    record["ok"] = True
+    return record
+
+
 def ledger_gate_record(records):
     """Gate decision for the committed perf ledger (pure — tested on
     synthetic record lists).
@@ -681,6 +751,18 @@ def ledger_gate_record(records):
 
 class ConfigFailed(RuntimeError):
     """One gated config could not produce a number; the others must still run."""
+
+
+def _fresh_artifact_path(path):
+    """Remove a stale artifact before re-producing it. The logs dir
+    persists across ratchet runs, so a gate whose producer crashed BEFORE
+    writing its artifact must not fall through onto the previous run's
+    clean file and judge evidence the producer never made (the
+    invariant-lint review's stale-artifact hazard; applies to every
+    crashed-producer fallthrough below)."""
+    if os.path.exists(path):
+        os.remove(path)
+    return path
 
 
 def run(cmd, log_path):
@@ -822,7 +904,9 @@ def run_config(name, spec, epochs, bar, args):
             raise ConfigFailed(f"no run dir matching trial_{trial} in {models}")
         run_dir = max(runs, key=os.path.getmtime)
         events = os.path.join(run_dir, "events.jsonl")
-        report_json = os.path.join(logs, "health_report.json")
+        report_json = _fresh_artifact_path(
+            os.path.join(logs, "health_report.json")
+        )
         report_log = os.path.join(logs, "health_report.log")
         try:
             run(
@@ -852,7 +936,9 @@ def run_config(name, spec, epochs, bar, args):
         # the SSL-recipe gate: recipes_eval --smoke runs every recipe
         # through the real driver + the supcon bit-identity A/B, then the
         # pure recipe_gate_record judges the artifact (CONFIGS note)
-        ev_json = os.path.join(logs, "recipes_eval.json")
+        ev_json = _fresh_artifact_path(
+            os.path.join(logs, "recipes_eval.json")
+        )
         ev_log = os.path.join(logs, "recipes_eval.log")
         try:
             run(
@@ -905,6 +991,38 @@ def run_config(name, spec, epochs, bar, args):
         record = ledger_gate_record(perf_ledger.load_ledger(path))
         record["bar"] = bar
         record["artifact"] = spec["artifact"]
+        print(json.dumps(record), flush=True)
+        return record
+
+    if kind == "invariant_lint":
+        # the static invariant-lint gate (CONFIGS note): run the linter
+        # over the tree, then judge the artifact with the pure record
+        lint_json = _fresh_artifact_path(
+            os.path.join(logs, "invariant_lint.json")
+        )
+        lint_log = os.path.join(logs, "invariant_lint.log")
+        try:
+            run(
+                [sys.executable, "scripts/invariant_lint.py",
+                 "--json", lint_json],
+                lint_log,
+            )
+        except ConfigFailed:
+            # the linter exits nonzero on findings but still writes the
+            # artifact — fall through so the gate record carries the
+            # structured findings (the health_report convention)
+            if not os.path.exists(lint_json):
+                raise
+        try:
+            with open(lint_json) as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ConfigFailed(
+                f"invariant_lint wrote no artifact: {e}"
+            ) from e
+        record = lint_gate_record(artifact)
+        record["bar"] = bar
+        record["log"] = lint_log
         print(json.dumps(record), flush=True)
         return record
 
@@ -1033,6 +1151,8 @@ def main():
                 metric = "ratchet_fleet_report"
             elif spec["kind"] == "perf_ledger":
                 metric = "ratchet_perf_ledger"
+            elif spec["kind"] == "invariant_lint":
+                metric = "ratchet_invariant_lint"
             elif spec["kind"] == "recipes":
                 metric = "ratchet_recipes"
             elif spec["kind"] in ("resident_ab", "window_ab"):

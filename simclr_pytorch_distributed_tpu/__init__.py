@@ -18,4 +18,15 @@ it TPU-first:
 
 __version__ = "0.1.0"
 
-from simclr_pytorch_distributed_tpu.ops.losses import supcon_loss  # noqa: F401
+
+def __getattr__(name):
+    # Lazy convenience re-export (PEP 562): the bare package import must
+    # stay jax-free so the stdlib-ast invariant linter
+    # (simclr_pytorch_distributed_tpu/analysis/, scripts/invariant_lint.py)
+    # really runs on a box with no jax — an eager `from ops.losses import
+    # supcon_loss` here pulled jax into every subpackage import.
+    if name == "supcon_loss":
+        from simclr_pytorch_distributed_tpu.ops.losses import supcon_loss
+
+        return supcon_loss
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,37 @@
+"""Static invariant linter for the repo's distributed contracts.
+
+Every hard-won correctness property of this codebase — the matched
+collective call schedule (the deadlock invariant PR 1/5/13 enforce by
+convention), the donation discipline (two latent segfault/torn-state bugs
+and one buffer-aliased EMA init so far), the zero-sync dispatch-only hot
+loop, and the writer/reader contract registries (metric-ring columns,
+schema-pinned artifacts, the shared trainer flags) — is otherwise enforced
+only by docstrings and dynamic tests that re-prove one configuration at a
+time. This package checks them STATICALLY over the whole tree with stdlib
+``ast`` (no jax import — the linter must run anywhere, instantly):
+
+- :mod:`.rule_collectives` — collective-schedule lint: no collective
+  reachable under a process-dependent conditional, after a
+  process-dependent early exit, or inside an exception-swallowing ``try``;
+- :mod:`.rule_donation` — donation-safety lint: no read of a donated
+  binding after the donating call;
+- :mod:`.rule_hotloop` — hot-loop sync lint: no sync-forcing host op
+  inside the jitted step builders or the drivers' flush-boundary loops;
+- :mod:`.rule_registry` — contract-registry checks: metric-key tuples
+  sorted+unique+single-sourced, ``build_output`` schemas pinned to module
+  constants, the trainers' shared argparse flags agreeing.
+
+Designed matched points (a collective under a conditional that IS agreed
+across processes by construction) live in :mod:`.allowlist` with a recorded
+reason; everything else is a finding. ``scripts/invariant_lint.py`` is the
+CLI; ``scripts/ratchet.py`` gates the tree on zero unallowlisted findings
+(the contract is hardware-independent, so the gate binds on every device).
+See docs/ANALYSIS.md.
+"""
+
+from simclr_pytorch_distributed_tpu.analysis.core import Finding  # noqa: F401
+from simclr_pytorch_distributed_tpu.analysis.runner import (  # noqa: F401
+    SCHEMA,
+    build_output,
+    run_lint,
+)

@@ -1,0 +1,50 @@
+"""The matched-point registry: designed exceptions, each with its reason.
+
+An entry here says: this finding's shape is real, but the point is MATCHED
+across processes (or the sync/read is designed) by a mechanism the static
+rule cannot see — and the reason records that mechanism so a reviewer can
+re-check it when the cited code changes. An entry with an empty reason is
+invalid (the runner rejects it), and an entry matching no finding is STALE
+and reported as one — the allowlist must shrink when the code gets
+cleaner.
+
+Keys are ``Finding.allowlist_key``: ``<rule>:<file>:<scope>:<symbol>``,
+deliberately line-number-free so edits above a designed point do not
+invalidate its entry.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+ALLOWLIST: Dict[str, str] = {
+    # -- train/supcon.py: the NaN-rollback recovery point -----------------
+    # The except NonFiniteLossError handler performs a collective
+    # crash-save and may swallow (rollback) rather than re-raise. This is
+    # the designed recovery point: NonFiniteLossError is raised on EVERY
+    # host at the same flush boundary by the collective failure-code
+    # exchange (TelemetrySession.check_failures_global allgathers the
+    # failure code, and the exit type is a pure function of the gathered
+    # code), and should_rollback() is deterministic per-host from
+    # meta-carried policy state — so all hosts enter the handler, run the
+    # collective save, and take the same swallow-vs-reraise branch
+    # together. docs/RESILIENCE.md "NaN policy".
+    "collective-schedule:swallowed-try:simclr_pytorch_distributed_tpu/"
+    "train/supcon.py:run:NonFiniteLossError:train_one_epoch":
+        "matched point: NonFiniteLossError is raised collectively on every "
+        "host by check_failures_global's failure-code allgather, and the "
+        "rollback-vs-reraise branch is deterministic from meta-carried "
+        "policy state — all hosts swallow or re-raise together",
+}
+
+
+def validate(allowlist: Dict[str, str] = None) -> None:
+    """Reject malformed entries up front (the gate's reason contract)."""
+    if allowlist is None:
+        allowlist = ALLOWLIST
+    for key, reason in allowlist.items():
+        if not isinstance(reason, str) or not reason.strip():
+            raise ValueError(
+                f"allowlist entry {key!r} carries no reason — every "
+                "designed matched point must record why it is safe"
+            )

@@ -1,0 +1,125 @@
+"""Collective classification + the module-level call graph.
+
+The repo's host-level collectives (the calls whose SCHEDULE must match
+across processes — docs/RESILIENCE.md, utils/telemetry.py): a process that
+skips one while its peers enter it deadlocks the pod. They are reached
+both as bare imports and as attributes (``preempt.requested_global``,
+``telemetry.flush_boundary``), so classification is by TERMINAL name
+(core.call_name), and reachability closes over same-module function calls
+(a driver calling its local ``submit_window`` helper reaches the
+collective inside it).
+
+In-program collectives (``lax.ppermute``/``psum`` under jit) are
+deliberately NOT here: inside one compiled SPMD program the schedule is
+XLA's problem; the deadlock class this lint targets is the HOST-level
+call-schedule divergence.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Set
+
+from simclr_pytorch_distributed_tpu.analysis.core import (
+    LintModule,
+    call_name,
+    scope_nodes,
+)
+
+# Host-level collective primitives and the repo functions that wrap them
+# (parallel/collectives.py, parallel/mesh.py, utils/preempt.py,
+# utils/telemetry.py, data/device_store.py, utils/checkpoint.py — orbax
+# multi-process saves are collective: every process must call save/wait).
+COLLECTIVE_CALLS = frozenset({
+    # jax multihost primitives
+    "process_allgather", "broadcast_one_to_all", "sync_global_devices",
+    # parallel/mesh.py + parallel/collectives.py wrappers
+    "broadcast_from_main", "sync_processes", "gather_global_labels",
+    # utils/preempt.py
+    "requested_global", "emergency_save_and_exit",
+    # utils/telemetry.py (flush_boundary/drain_global/finish_epoch all
+    # contain the failure-code allgather)
+    "check_failures_global", "drain_global", "flush_boundary",
+    "finish_epoch",
+    # data/device_store.py (placement resolution allgathers per rung)
+    "_agree_across_processes", "resolve_data_placement", "make_store",
+    # utils/checkpoint.py (orbax multi-process saves are collective)
+    "save_checkpoint", "wait_for_saves",
+})
+
+# Calls whose value is PROCESS-DEPENDENT (differs across processes): a
+# branch on one selects different collective schedules on different hosts.
+PROCESS_DEPENDENT_CALLS = frozenset({"is_main_process", "process_index"})
+
+# Process-UNIFORM runtime queries (same value everywhere) — listed so the
+# classifier's intent is explicit: ``if jax.process_count() == 1: ...`` is
+# the repo's standard single-process short-circuit, NOT a hazard.
+PROCESS_UNIFORM_CALLS = frozenset({"process_count"})
+
+
+def reaching_functions(mod: LintModule, targets: frozenset) -> Set[str]:
+    """Names of functions in ``mod`` that (transitively, via same-module
+    bare-name calls) make a call whose terminal name is in ``targets``.
+
+    The fixed point runs over ALL function defs in the module, module-level
+    and nested alike, keyed by bare name — the resolution a same-module
+    call site actually uses.
+    """
+    calls: Dict[str, Set[str]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            called = set()
+            for sub in ast.walk(node):
+                name = call_name(sub)
+                if name:
+                    called.add(name)
+            # a name defined twice keeps the union (conservative)
+            calls.setdefault(node.name, set()).update(called)
+
+    reaching: Set[str] = {
+        name for name, called in calls.items() if called & targets
+    }
+    changed = True
+    while changed:
+        changed = False
+        for name, called in calls.items():
+            if name not in reaching and called & reaching:
+                reaching.add(name)
+                changed = True
+    return reaching
+
+
+def collective_reachers(mod: LintModule) -> Set[str]:
+    return reaching_functions(mod, COLLECTIVE_CALLS)
+
+
+def is_collective_call(node: ast.AST, reachers: Set[str]) -> bool:
+    """Does this Call node enter a collective (directly or via a
+    same-module function known to reach one)?"""
+    name = call_name(node)
+    if name is None:
+        return False
+    if name in COLLECTIVE_CALLS:
+        return True
+    # transitive resolution only for BARE-name calls: attribute calls
+    # resolve to other objects' methods, which terminal-name matching
+    # already covered above
+    return isinstance(node.func, ast.Name) and name in reachers
+
+
+def expr_is_process_dependent(expr: ast.AST) -> bool:
+    """Does evaluating ``expr`` read a per-process value? (Calls to
+    ``is_main_process``/``process_index`` anywhere inside — bare or as
+    attributes — make a test process-dependent; ``process_count`` does
+    not.)"""
+    for node in ast.walk(expr):
+        name = call_name(node)
+        if name in PROCESS_DEPENDENT_CALLS:
+            return True
+    return False
+
+
+def flush_boundary_reachers(mod: LintModule) -> Set[str]:
+    """Functions reaching the telemetry flush boundary — the hot-loop
+    rule's loop marker (rule_hotloop)."""
+    return reaching_functions(mod, frozenset({"flush_boundary"}))

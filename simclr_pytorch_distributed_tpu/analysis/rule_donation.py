@@ -1,0 +1,237 @@
+"""Donation-safety lint: no read of a donated binding after donation.
+
+The bug class this rule reconstructs has bitten this repo three times
+(PR 1 twice, PR 12 once): a buffer handed to a ``jit(...,
+donate_argnums=...)`` program is DELETED on dispatch — any later host read
+(or re-dispatch of the same object) touches freed/aliased device memory:
+a segfault on a good day, silently torn state on a bad one.
+
+Statically: a call through a donating callable whose donated argument is a
+plain Name that the call's own statement does NOT rebind, followed by a
+later lexical read of that Name in the same scope (or the same call again
+from inside a loop), is a finding.
+
+Donating callables are found three ways, all per-module with a shared
+cross-module seed registry:
+
+- a ``jax.jit``/``jit`` call with a literal ``donate_argnums=...``;
+- a function whose return sites are such jit calls (a step BUILDER: the
+  intersection of the return sites' donated positions — only positions
+  donated in EVERY variant are assumed, so the scalar/ring signature split
+  in ``make_fused_update`` doesn't over-claim);
+- the repo's known builder/parameter names (``make_fused_update``,
+  ``jit_scalar_or_ring_step``, drivers' ``update_fn``/``train_jit``
+  parameters) so the real call sites in the drivers are checked even
+  though the jit happens a module away.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from simclr_pytorch_distributed_tpu.analysis.core import (
+    Finding,
+    LintModule,
+    assigned_names,
+    call_name,
+    end_line,
+    scope_nodes,
+    statement_of,
+)
+
+RULE = "donation-safety:post-donation-read"
+
+# Cross-module seed: builders that RETURN a donating callable, with the
+# donated positions their returned callables share across variants
+# (train/supcon.make_fused_update, train/linear.jit_scalar_or_ring_step:
+# position 0 = the TrainState; the ring at position 1 is donated only in
+# ring mode, so it is deliberately not assumed).
+KNOWN_DONATING_BUILDERS: Dict[str, Tuple[int, ...]] = {
+    "make_fused_update": (0,),
+    "jit_scalar_or_ring_step": (0,),
+}
+
+# Parameter names through which the drivers receive a donating step
+# callable (train_one_epoch's ``update_fn``, the probe/CE loops'
+# ``train_jit``): the jit lives a module away, but the call sites these
+# names mark are exactly where the PR-1 bugs lived.
+KNOWN_DONATING_PARAMS: Dict[str, Tuple[int, ...]] = {
+    "update_fn": (0,),
+    "train_jit": (0,),
+}
+
+
+def _donate_argnums(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Literal donate_argnums of a jit call, or None."""
+    if call_name(call) != "jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Tuple):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        out.append(e.value)
+                    else:
+                        return None
+                return tuple(out)
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            return None
+    return None
+
+
+def _module_donating_builders(mod: LintModule) -> Dict[str, Tuple[int, ...]]:
+    """Function names in ``mod`` whose return value donates: direct
+    ``return jit(..., donate_argnums=...)`` sites, plus functions whose
+    return is a call to an already-known builder. Positions = the
+    intersection over all donating return sites."""
+    builders: Dict[str, Tuple[int, ...]] = dict(KNOWN_DONATING_BUILDERS)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name in builders:
+                continue
+            positions: Optional[set] = None
+            saw_donating_return = False
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Return) and sub.value is not None):
+                    continue
+                ret = sub.value
+                pos = None
+                if isinstance(ret, ast.Call):
+                    pos = _donate_argnums(ret)
+                    if pos is None and call_name(ret) in builders:
+                        pos = builders[call_name(ret)]
+                if pos is not None:
+                    saw_donating_return = True
+                    positions = (
+                        set(pos) if positions is None
+                        else positions & set(pos)
+                    )
+            if saw_donating_return and positions:
+                builders[node.name] = tuple(sorted(positions))
+                changed = True
+    return builders
+
+
+def _scope_donating_vars(
+    mod: LintModule, scope: ast.AST, builders: Dict[str, Tuple[int, ...]],
+) -> Dict[str, Tuple[int, ...]]:
+    """Names in ``scope`` bound to a donating callable: direct
+    ``x = jit(..., donate_argnums=...)`` / ``x = <builder>(...)``
+    assignments, plus the known donating parameter names when ``scope``
+    declares them."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        for arg in list(scope.args.args) + list(scope.args.kwonlyargs):
+            if arg.arg in KNOWN_DONATING_PARAMS:
+                out[arg.arg] = KNOWN_DONATING_PARAMS[arg.arg]
+    for node in scope_nodes(mod, scope):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        pos = _donate_argnums(value)
+        if pos is None and call_name(value) in builders \
+                and isinstance(value.func, (ast.Name, ast.Attribute)):
+            pos = builders[call_name(value)]
+        if pos:
+            out[node.targets[0].id] = pos
+    return out
+
+
+def _enclosing_loop(mod: LintModule, node: ast.AST, scope: ast.AST):
+    cur = mod.parent(node)
+    while cur is not None and cur is not scope:
+        if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return None
+        cur = mod.parent(cur)
+    return None
+
+
+def check_module(mod: LintModule) -> List[Finding]:
+    findings: List[Finding] = []
+    builders = _module_donating_builders(mod)
+
+    for scope_name, scope in mod.function_scopes():
+        donating = _scope_donating_vars(mod, scope, builders)
+        if not donating:
+            continue
+        # loads of each name, by line, for the post-donation scan
+        loads: Dict[str, List[ast.Name]] = {}
+        for node in scope_nodes(mod, scope):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                loads.setdefault(node.id, []).append(node)
+
+        for node in scope_nodes(mod, scope):
+            if not isinstance(node, ast.Call):
+                continue
+            fn_name = None
+            if isinstance(node.func, ast.Name):
+                fn_name = node.func.id
+            if fn_name not in donating:
+                continue
+            stmt = statement_of(mod, node)
+            rebound = assigned_names(stmt)
+            for pos in donating[fn_name]:
+                if pos >= len(node.args):
+                    continue
+                arg = node.args[pos]
+                if not isinstance(arg, ast.Name):
+                    continue
+                donated = arg.id
+                if donated in rebound:
+                    continue  # the canonical `state, ring = f(state, ring)`
+                key = f"{RULE}:{mod.rel}:{scope_name}:{fn_name}:{donated}"
+                later = [
+                    n for n in loads.get(donated, ())
+                    if n.lineno > end_line(stmt) and n is not arg
+                ]
+                if later:
+                    first = min(later, key=lambda n: n.lineno)
+                    findings.append(Finding(
+                        rule=RULE, file=mod.rel, line=first.lineno,
+                        why=(
+                            f"{donated!r} is donated to {fn_name}() at line "
+                            f"{node.lineno} (its device buffers are deleted "
+                            "on dispatch) but read again here without being "
+                            "rebound by the donating call — the PR-1 "
+                            "use-after-donation class (segfault or torn "
+                            "state)"
+                        ),
+                        allowlist_key=key,
+                    ))
+                    continue
+                loop = _enclosing_loop(mod, node, scope)
+                if loop is not None:
+                    # not rebound by the call's own statement: is it rebound
+                    # anywhere else in the loop before the next iteration?
+                    rebinds_in_loop = any(
+                        donated in assigned_names(s)
+                        for s in ast.walk(loop) if isinstance(s, ast.stmt)
+                    )
+                    if not rebinds_in_loop:
+                        findings.append(Finding(
+                            rule=RULE, file=mod.rel, line=node.lineno,
+                            why=(
+                                f"{donated!r} is donated to {fn_name}() "
+                                "inside a loop without ever being rebound: "
+                                "the next iteration re-dispatches a deleted "
+                                "buffer"
+                            ),
+                            allowlist_key=key,
+                        ))
+    return findings

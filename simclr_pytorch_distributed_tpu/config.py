@@ -344,11 +344,6 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--optimizer", type=str, default=d.optimizer,
                    choices=["sgd", "lars"],
                    help="lars: layer-adaptive scaling for large global batches")
-    p.add_argument("--trace_dir", type=str, default=d.trace_dir,
-                   help="capture a jax.profiler trace into this dir")
-    p.add_argument("--trace_start_step", type=int, default=d.trace_start_step)
-    p.add_argument("--trace_steps", type=int, default=d.trace_steps)
-    p.add_argument("--compile_cache", type=str, default=d.compile_cache)
     _add_bool_flag(p, "remat", help="remat residual blocks (HBM for recompute)")
     p.add_argument("--nan_guard", type=_parse_bool,
                    default=d.nan_guard, help="abort + checkpoint on NaN loss")
@@ -358,29 +353,6 @@ def supcon_parser() -> argparse.ArgumentParser:
                         "code 1, docs/RESILIENCE.md — what the supervisor "
                         "keys on), or restore the epoch backup, halve the "
                         "LR, and continue")
-    p.add_argument("--telemetry", type=str, default=d.telemetry,
-                   choices=["async", "sync"],
-                   help="metric flush: background thread (zero sync on the "
-                        "hot loop; NaN detection <=1 window late) or inline")
-    p.add_argument("--data_placement", type=str, default=d.data_placement,
-                   choices=["host", "device", "window", "auto"],
-                   help="training batches: 'device' = HBM-resident epoch "
-                        "buffer; 'window' = double-buffered streaming "
-                        "window, one H2D per window (fits datasets HBM "
-                        "can't hold, incl. memmap-backed trees); 'auto' "
-                        "walks the device->window->host ladder; 'host' = "
-                        "per-step H2D")
-    p.add_argument("--data_window_batches",
-                   type=positive_int_arg("data_window_batches"),
-                   default=d.data_window_batches,
-                   help="windowed placement: batches per resident window "
-                        "(HBM cost = 2x one window: training + shadow)")
-    p.add_argument("--device_budget_mb",
-                   type=positive_int_arg("device_budget_mb"),
-                   default=d.device_budget_mb,
-                   help="override the per-device placement budget in MB "
-                        "(default: 0.4x free memory_stats, 4 GB fallback "
-                        "where the backend reports no stats)")
     p.add_argument("--health_freq", type=nonnegative_int_arg("health_freq"),
                    default=d.health_freq,
                    help="compute the representation-health diagnostics "
@@ -435,6 +407,7 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--probe_lr", type=float, default=d.probe_lr,
                    help="online probe SGD learning rate (constant; the "
                         "probe chases a moving encoder)")
+    _add_shared_runtime_flags(p, d)
     _add_observability_flags(p, d)
     return p
 
@@ -459,9 +432,51 @@ def nonnegative_int_arg(name: str):
     return parse
 
 
+def _add_shared_runtime_flags(p: argparse.ArgumentParser, d) -> None:
+    """The shared runtime surface (telemetry/data-placement/profiling/
+    compile-cache): ONE registry serving all three trainers' parsers.
+
+    These flags mean the same thing on every stage, so they must parse the
+    same way everywhere — previously three hand-synced copies, now the one
+    definition the invariant linter's flag-consistency rule
+    (analysis/rule_registry.py SHARED_RUNTIME_FLAGS) verifies by USAGE:
+    registering one of these inline in a parser again is a lint finding,
+    and the dataclass defaults (``d.<field>``) must agree across
+    SupConConfig/LinearConfig.
+    """
+    p.add_argument("--telemetry", type=str, default=d.telemetry,
+                   choices=["async", "sync"],
+                   help="metric flush: background thread (zero sync on the "
+                        "hot loop; NaN detection <=1 window late) or inline")
+    p.add_argument("--data_placement", type=str, default=d.data_placement,
+                   choices=["host", "device", "window", "auto"],
+                   help="training batches: 'device' = HBM-resident epoch "
+                        "buffer; 'window' = double-buffered streaming "
+                        "window, one H2D per window (fits datasets HBM "
+                        "can't hold, incl. memmap-backed trees); 'auto' "
+                        "walks the device->window->host ladder; 'host' = "
+                        "per-step H2D")
+    p.add_argument("--data_window_batches",
+                   type=positive_int_arg("data_window_batches"),
+                   default=d.data_window_batches,
+                   help="windowed placement: batches per resident window "
+                        "(HBM cost = 2x one window: training + shadow)")
+    p.add_argument("--device_budget_mb",
+                   type=positive_int_arg("device_budget_mb"),
+                   default=d.device_budget_mb,
+                   help="override the per-device placement budget in MB "
+                        "(default: 0.4x free memory_stats, 4 GB fallback "
+                        "where the backend reports no stats)")
+    p.add_argument("--trace_dir", type=str, default=d.trace_dir,
+                   help="capture a jax.profiler trace into this dir")
+    p.add_argument("--trace_start_step", type=int, default=d.trace_start_step)
+    p.add_argument("--trace_steps", type=int, default=d.trace_steps)
+    p.add_argument("--compile_cache", type=str, default=d.compile_cache)
+
+
 def _add_observability_flags(p: argparse.ArgumentParser, d) -> None:
     """The shared observability surface (docs/OBSERVABILITY.md): identical
-    on all three trainers, like --telemetry/--data_placement."""
+    on all three trainers, like the runtime flags above."""
     p.add_argument("--flight_recorder", type=str, default=d.flight_recorder,
                    choices=["on", "off"],
                    help="host-boundary span/event recorder -> "
@@ -714,28 +729,7 @@ def linear_parser(ce: bool = False) -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--workdir", type=str, default=d.workdir)
     p.add_argument("--trial", type=str, default=d.trial)
-    p.add_argument("--compile_cache", type=str, default=d.compile_cache)
-    p.add_argument("--telemetry", type=str, default=d.telemetry,
-                   choices=["async", "sync"],
-                   help="metric flush: background thread or inline")
-    p.add_argument("--data_placement", type=str, default=d.data_placement,
-                   choices=["host", "device", "window", "auto"],
-                   help="training batches: HBM-resident epoch buffer "
-                        "('device'), double-buffered streaming window "
-                        "('window'), per-step H2D ('host'), or walk the "
-                        "device->window->host ladder ('auto')")
-    p.add_argument("--data_window_batches",
-                   type=positive_int_arg("data_window_batches"),
-                   default=d.data_window_batches,
-                   help="windowed placement: batches per resident window")
-    p.add_argument("--device_budget_mb",
-                   type=positive_int_arg("device_budget_mb"),
-                   default=d.device_budget_mb,
-                   help="override the per-device placement budget in MB")
-    p.add_argument("--trace_dir", type=str, default=d.trace_dir,
-                   help="capture a jax.profiler trace into this dir")
-    p.add_argument("--trace_start_step", type=int, default=d.trace_start_step)
-    p.add_argument("--trace_steps", type=int, default=d.trace_steps)
+    _add_shared_runtime_flags(p, d)
     _add_observability_flags(p, d)
     return p
 

@@ -1,0 +1,4 @@
+# Known-bad fixture corpus for the invariant linter (tests/test_invariant_lint.py).
+# Each module is a MINIMAL reconstruction of one real bug class from this
+# repo's history; the tests assert each rule fires on its fixture exactly
+# once. Never imported — the linter parses, it does not execute.

@@ -1,0 +1,15 @@
+"""KNOWN-BAD: a collective under a process-dependent conditional.
+
+The split-verdict deadlock shape: only process 0 enters the allgather-
+backed checkpoint save, every other process dispatches the next step —
+the pod wedges inside the collective. (The class the device_store
+placement review fix closed: PR 5 "the 'auto' verdict is COLLECTIVE".)
+"""
+
+
+def save_if_main(state, save_folder, config, epoch, is_main_process,
+                 save_checkpoint):
+    if is_main_process():
+        # orbax multi-process saves are collective: every process must call
+        save_checkpoint(save_folder, "ckpt", state, config=config,
+                        epoch=epoch)

@@ -1,0 +1,17 @@
+"""KNOWN-BAD: a read of a donated binding after the donating call.
+
+The PR-1 reconstruction: the crash handler saved the SAME ``state`` object
+the jitted update had already donated — on the real chip its buffers were
+deleted on dispatch, and the resume segfaulted within 2 steps (the second
+PR-1 variant persisted a torn state mid-background-write). ``update_fn``
+is the drivers' donating step callable (donate_argnums=(0,)).
+"""
+
+
+def step_then_crash_save(update_fn, state, ring_buf, images, labels, key,
+                         save_folder, config):
+    new_state, ring_buf = update_fn(state, ring_buf, images, labels, key)
+    # BUG: `state` was donated above — its device buffers are gone
+    snapshot = {"params": state.params, "config": config,
+                "folder": save_folder}
+    return new_state, ring_buf, snapshot

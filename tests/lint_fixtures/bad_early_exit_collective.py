@@ -1,0 +1,12 @@
+"""KNOWN-BAD: a process-dependent early exit ahead of a collective.
+
+The lone-host-leaves-the-loop hazard: non-main processes return before
+the collective drain, the main process blocks in it forever (the hazard
+drain_global/check_failures_global document as 'a lone host raising out
+of a plain drain would skip the collective save its peers enter')."""
+
+
+def finish(telemetry, is_main_process, step):
+    if not is_main_process():
+        return
+    telemetry.drain_global(step)

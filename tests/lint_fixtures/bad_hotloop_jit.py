@@ -1,0 +1,18 @@
+"""KNOWN-BAD: a host materialization inside a jitted step function.
+
+``np.asarray`` on a traced value either crashes at trace time or silently
+constant-folds a stale value into the compiled program — both belong at
+review time, not on the chip.
+"""
+
+import jax
+import numpy as np
+
+
+def make_step(tx):
+    def step(state, batch):
+        grads = grad_fn(state, batch)
+        gnorm = np.asarray(grads)  # BUG: host op under trace
+        return apply_updates(tx, state, grads), gnorm
+
+    return jax.jit(step, donate_argnums=(0,))

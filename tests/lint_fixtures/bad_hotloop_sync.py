@@ -1,0 +1,25 @@
+"""KNOWN-BAD: a sync-forcing host op inside a flush-boundary hot loop.
+
+The zero-sync contract (PR 4/5): between flush boundaries the main thread
+only dispatches. ``float()`` on the step's device output is the
+reference's per-iter ``loss.item()`` sync point reborn — one blocking D2H
+per step. The annotated line below is a DESIGNED sync site (reason
+recorded) and must NOT fire; the bare-marker line must fire the
+missing-reason rule.
+"""
+
+import time
+
+
+def epoch(update_fn, state, ring_buf, batches, key, telemetry, consume,
+          print_freq):
+    for idx, (images, labels) in enumerate(batches):
+        state, ring_buf = update_fn(state, ring_buf, images, labels, key)
+        loss = float(state.last_loss)  # BUG: per-step blocking readback
+        # designed site, reason recorded — suppressed by the annotation:
+        t = float(time.time() - state.t0)  # sync-ok: host wall-clock only, no device value involved
+        # marker without a reason — itself a finding:
+        u = bool(state.flag)  # sync-ok
+        if (idx + 1) % print_freq == 0:
+            telemetry.flush_boundary(ring_buf, consume, step_hint=idx)
+    return loss, t, u

@@ -1,0 +1,17 @@
+"""KNOWN-BAD: a collective inside an exception-swallowing try.
+
+Exception delivery is per-host (a local TB IOError, a local orbax fault):
+the host that swallows keeps its loop running while the host that raised
+left it — their collective schedules diverge at the next boundary. The
+repo's real recovery points route failures through the COLLECTIVE
+failure-code exchange instead (utils/telemetry.py check_failures_global).
+"""
+
+import logging
+
+
+def boundary(telemetry, ring_buf, consume, step):
+    try:
+        telemetry.flush_boundary(ring_buf, consume, step_hint=step)
+    except OSError:
+        logging.warning("flush failed; continuing")  # local swallow
