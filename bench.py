@@ -108,7 +108,7 @@ BENCH_WINDOW_BATCHES = 8
 
 
 def _setup_pretrain(mesh, batch, size, stem, data_placement="host",
-                    recipe="simclr", moco_queue=0):
+                    recipe="simclr", moco_queue=0, conv_impl="xla"):
     """The headline workload: fused SimCLR pretrain step (recipe config).
 
     ``data_placement='device'`` benches the resident-store step instead
@@ -151,11 +151,26 @@ def _setup_pretrain(mesh, batch, size, stem, data_placement="host",
     )
     from simclr_pytorch_distributed_tpu.train.supcon_step import SupConStepConfig
 
+    from simclr_pytorch_distributed_tpu.train.supcon import resolve_conv_impl
+
     steps_per_epoch = 50000 // batch
-    # bf16 compute on the MXU; fp32 params/BN stats/loss.
+    # bf16 compute on the MXU; fp32 params/BN stats/loss. The pallas
+    # conv-block arm runs fp32 END TO END (the fused kernels are
+    # fp32-only this round, docs/PERF.md round 15) — its vs_baseline
+    # against the recorded bf16 XLA-path headline is therefore the honest
+    # whole-trade number (kernel fusion win minus the bf16 give-back),
+    # not a like-for-like dtype comparison; the config string names it.
+    if conv_impl == "pallas":
+        conv_impl, conv_reason = resolve_conv_impl(
+            "pallas", "resnet50", batch, size, len(jax.devices()), bf16=False
+        )
+    else:
+        conv_reason = "explicit request: bitwise-pinned XLA conv path"
+    print(f"[conv_impl] '{conv_impl}': {conv_reason}")
     model = SupConResNet(
-        model_name="resnet50", head="mlp", feat_dim=128, dtype=jnp.bfloat16,
-        stem=stem,
+        model_name="resnet50", head="mlp", feat_dim=128,
+        dtype=jnp.float32 if conv_impl == "pallas" else jnp.bfloat16,
+        stem=stem, conv_impl=conv_impl,
     )
     schedule = make_lr_schedule(
         learning_rate=0.5, epochs=100, steps_per_epoch=steps_per_epoch, cosine=True
@@ -215,11 +230,14 @@ def _setup_pretrain(mesh, batch, size, stem, data_placement="host",
         labels = rng.integers(0, 10, size=(batch,)).astype(np.int32)
         sh_images, sh_labels = shard_host_batch((images, labels), mesh)
 
+    dtype_token = "fp32" if conv_impl == "pallas" else "bf16"
     config = (
-        f"{recipe} rn50 cifar-recipe bf16 fused-aug bsz{batch} loss={loss_impl}"
+        f"{recipe} rn50 cifar-recipe {dtype_token} fused-aug bsz{batch} "
+        f"loss={loss_impl}"
         + ("" if not moco_queue else f" moco_queue={moco_queue}")
         + ("" if stem == "conv" else f" stem={stem}")
         + ("" if data_placement == "host" else f" data={data_placement}")
+        + ("" if conv_impl == "xla" else f" conv={conv_impl}")
     )
     return update, sh_images, sh_labels, state, "pretrain", config
 
@@ -351,6 +369,16 @@ def main(argv=None):
              "(multiple of 2*batch_size; forces the dense loss path)",
     )
     ap.add_argument(
+        "--conv_impl", choices=["xla", "pallas"], default="xla",
+        help="encoder conv-block path (ops/pallas_conv.py): 'pallas' "
+             "benches the fused conv+BN+ReLU stem/BasicBlock kernels "
+             "(fp32 end-to-end — the kernels are fp32-only); default "
+             "'xla' keeps the gated baseline arm exactly today's path. "
+             "vs_baseline stays pinned to the recorded XLA-path headline "
+             "until a new baseline is committed, so the pallas arm's "
+             "number IS the measured whole-trade win/loss",
+    )
+    ap.add_argument(
         "--ledger", nargs="?", const="docs/perf_ledger.jsonl", default="",
         metavar="PATH",
         help="append this run to the longitudinal perf ledger "
@@ -374,6 +402,15 @@ def main(argv=None):
     if ((args.recipe != "simclr" or args.moco_queue)
             and args.stage != "pretrain"):
         ap.error("--recipe/--moco_queue apply to --stage pretrain only")
+    if args.conv_impl != "xla" and args.stage != "pretrain":
+        ap.error("--conv_impl applies to --stage pretrain only")
+    if args.conv_impl == "pallas" and args.stem != "conv":
+        # honored-or-raise: the fused stem kernel implements the 'conv'
+        # stem only, and rn50's blocks never fuse — a pallas-labeled s2d
+        # run would record a pure-XLA measurement under the pallas ledger
+        # fingerprint
+        ap.error("--conv_impl pallas requires the default --stem conv "
+                 "(the fused kernel implements the conv stem only)")
 
     from simclr_pytorch_distributed_tpu.parallel.mesh import create_mesh
 
@@ -387,6 +424,7 @@ def main(argv=None):
         setup = _setup_pretrain(
             mesh, batch, size, args.stem, data_placement=args.data_placement,
             recipe=args.recipe, moco_queue=args.moco_queue,
+            conv_impl=args.conv_impl,
         )
     elif args.stage == "linear":
         setup = _setup_linear(mesh, batch, size)
@@ -477,6 +515,9 @@ def main(argv=None):
         # arm KEEPS vs_baseline: the comparison against the supcon-family
         # headline is the recipe-overhead measurement (the ratchet bench
         # gate only runs the default arm, so the bar never binds on it).
+        # Likewise --conv_impl pallas: vs_baseline stays pinned to the
+        # recorded XLA-path headline until a new baseline is committed,
+        # so the pallas arm reports the measured whole-trade win/loss.
         "vs_baseline": (
             vs_baseline_for(metric_stage, per_chip)
             if args.batch_size == 256 and args.stem == "conv"
@@ -488,6 +529,10 @@ def main(argv=None):
             "global_batch": batch,
             "recipe": getattr(args, "recipe", "simclr"),
             "moco_queue": getattr(args, "moco_queue", 0),
+            # the explicit conv path (honored-or-raise, so the flag IS the
+            # effective impl): the ledger fingerprint keys on it so
+            # regression scans never compare across kernel implementations
+            "conv_impl": getattr(args, "conv_impl", "xla"),
             "chips": n_chips,
             "device_kind": device_kind,
             "total_imgs_per_sec": round(imgs_per_sec, 1),

@@ -192,6 +192,18 @@ CONFIGS = {
     # list.
     "perf_ledger": dict(model=None, epochs=0, bar=None, kind="perf_ledger",
                         dataset=None, artifact="docs/perf_ledger.jsonl"),
+    # round 15: the fused conv-block gate (scripts/convblock_ab.py --smoke;
+    # ops/pallas_conv.py). Binds EVERYWHERE on parity_ok — the interpret-
+    # mode fused residual-block kernel matching the bitwise-pinned Flax
+    # block in value, all seven gradients, and BN batch stats (parity is
+    # hardware-independent; it is the contract that lets --conv_impl swap
+    # without touching the accuracy ratchets). The timing claim (the
+    # pallas arm removing the injected per-HBM-traversal delay) is a
+    # CPU-calibrated proxy and pass-skips off-CPU with the reason on
+    # record (the resident_ab/window_ab convention). Seconds, so it rides
+    # the default list.
+    "convblock": dict(model=None, epochs=0, bar=None, kind="convblock_ab",
+                      dataset="synthetic"),
     # round 14: the static invariant-lint gate (docs/ANALYSIS.md). Runs
     # scripts/invariant_lint.py over the tree — stdlib ast, no driver, no
     # device — and binds on the pure lint_gate_record EVERYWHERE: zero
@@ -342,6 +354,54 @@ def window_gate_record(artifact):
         artifact, "window", "window_ms_per_step",
         extra_keys=("window_batches",),
     )
+
+
+def convblock_gate_record(artifact):
+    """Gate decision for one convblock_ab artifact (pure — tested without
+    a kernel run).
+
+    ``parity_ok`` (interpret-mode fused kernel == Flax block: value,
+    gradients, BN stats within the artifact's pinned tolerances) binds on
+    EVERY device — kernel correctness is hardware-independent. The timing
+    claim (the pallas arm beating the xla arm under the injected
+    per-HBM-traversal delay) binds only on CPU, where the proxy is
+    calibrated; elsewhere the gate pass-skips the timing with the reason
+    on record (the placement A/Bs' convention).
+    """
+    s = artifact["summary"]
+    parity = artifact["parity"]
+    record = {
+        "metric": "ratchet_convblock_ab_parity",
+        "value": s.get("pallas_ms_per_step"),
+        "xla_ms_per_step": s.get("xla_ms_per_step"),
+        "traversals": artifact.get("traversals", {}),
+        "parity_ok": parity["parity_ok"],
+        "max_abs_diffs": parity["max_abs_diffs"],
+        "device": artifact["device"],
+    }
+    if not parity["parity_ok"]:
+        record["ok"] = False
+        record["error"] = (
+            "fused conv-block kernel diverges from the Flax block "
+            f"(value_ok={parity['value_ok']} grads_ok={parity['grads_ok']} "
+            f"stats_ok={parity['stats_ok']})"
+        )
+        return record
+    if artifact["device"] != "cpu":
+        record["ok"] = True
+        record["skipped"] = (
+            f"device {artifact['device']!r}: injected-delay timing proxy "
+            f"calibrated for CPU only; kernel parity still enforced"
+        )
+        return record
+    record["ok"] = bool(
+        s["pallas_ms_per_step"] < s["xla_ms_per_step"]
+    )
+    if not record["ok"]:
+        record["error"] = (
+            "pallas arm not faster under the injected per-traversal delay"
+        )
+    return record
 
 
 def trace_report_gate_record(artifact):
@@ -835,6 +895,37 @@ def run_config(name, spec, epochs, bar, args):
         gate = (resident_gate_record if kind == "resident_ab"
                 else window_gate_record)
         record = gate(artifact)
+        record["bar"] = bar
+        record["log"] = ab_log
+        print(json.dumps(record), flush=True)
+        return record
+
+    if kind == "convblock_ab":
+        # the fused conv-block gate: interpret-mode kernel parity + the
+        # CPU-proxy traversal timing (convblock_gate_record); stale
+        # artifact removed BEFORE the producer runs (the PR-14
+        # crashed-producer convention)
+        ab_json = _fresh_artifact_path(os.path.join(logs, f"{kind}.json"))
+        ab_log = os.path.join(logs, f"{kind}.log")
+        try:
+            run(
+                [sys.executable, "scripts/convblock_ab.py", "--smoke",
+                 "--json", ab_json],
+                ab_log,
+            )
+        except ConfigFailed:
+            # convblock_ab exits nonzero on broken parity but still
+            # writes the artifact — fall through so the gate record
+            # carries the structured per-tensor diffs (the health_report
+            # convention); re-raise only with no artifact to judge
+            if not os.path.exists(ab_json):
+                raise
+        try:
+            with open(ab_json) as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ConfigFailed(f"{kind} wrote no artifact: {e}") from e
+        record = convblock_gate_record(artifact)
         record["bar"] = bar
         record["log"] = ab_log
         print(json.dumps(record), flush=True)

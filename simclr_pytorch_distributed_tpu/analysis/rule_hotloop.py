@@ -14,7 +14,14 @@ property over two region kinds:
 - **boundary loops**: the innermost ``for``/``while`` enclosing a call
   that reaches ``TelemetrySession.flush_boundary`` (directly or through a
   local helper like the drivers' ``submit_window``) — exactly the
-  boundary-to-boundary driver loops the zero-sync contract covers.
+  boundary-to-boundary driver loops the zero-sync contract covers;
+- **Pallas kernel builders**: any local function handed to
+  ``pl.pallas_call`` as the kernel — directly, or through a
+  ``functools.partial(<kernel>, ...)`` (possibly via an intermediate
+  assignment, the ops/pallas_loss.py / ops/pallas_conv.py shape). A host
+  sync inside a kernel body would either fail the TPU lowering or
+  silently constant-fold in interpret mode while the compiled path
+  diverges — both review-time findings.
 
 Forbidden inside: ``jax.device_get``, ``.block_until_ready()``,
 ``.item()``, ``np.asarray``/``np.array`` (a device->host materialization),
@@ -40,6 +47,7 @@ from simclr_pytorch_distributed_tpu.analysis.core import (
 
 RULE_LOOP = "hot-loop-sync:boundary-loop"
 RULE_JIT = "hot-loop-sync:jitted-fn"
+RULE_KERNEL = "hot-loop-sync:pallas-kernel"
 RULE_ANNOTATION = "hot-loop-sync:annotation-missing-reason"
 
 _SYNC_METHODS = frozenset({"block_until_ready", "item"})
@@ -109,6 +117,58 @@ def _jitted_functions(mod: LintModule) -> Set[ast.AST]:
     return out
 
 
+def _pallas_kernel_functions(mod: LintModule) -> Set[ast.AST]:
+    """Function defs handed to ``pallas_call`` as the kernel: the first
+    positional argument as a bare Name, an inline
+    ``functools.partial(<def>, ...)``, or a Name bound earlier in the
+    module to such a partial (the ops/pallas_loss.py builder shape)."""
+    by_name: dict = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+    # var name -> EVERY function it is bound to via functools.partial,
+    # module-wide: builders routinely reuse one local name ('kernel ='),
+    # and a linter must over-approximate — resolving only the last
+    # binding would silently drop all but one kernel from coverage
+    partial_of: dict = {}
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+            and call_name(node.value) == "partial"
+            and node.value.args
+            and isinstance(node.value.args[0], ast.Name)
+        ):
+            partial_of.setdefault(node.targets[0].id, set()).add(
+                node.value.args[0].id
+            )
+    out: Set[ast.AST] = set()
+    for node in ast.walk(mod.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and call_name(node) == "pallas_call"
+            and node.args
+        ):
+            continue
+        arg = node.args[0]
+        names = []
+        if isinstance(arg, ast.Name):
+            names.append(arg.id)
+            names.extend(partial_of.get(arg.id, ()))
+        elif (
+            isinstance(arg, ast.Call)
+            and call_name(arg) == "partial"
+            and arg.args
+            and isinstance(arg.args[0], ast.Name)
+        ):
+            names.append(arg.args[0].id)
+        for nm in names:
+            out.update(by_name.get(nm, ()))
+    return out
+
+
 def _boundary_loops(mod: LintModule) -> Set[ast.AST]:
     """Innermost loops enclosing a flush-boundary call — direct, or via a
     LOCAL helper (a function defined inside the same enclosing function,
@@ -168,6 +228,8 @@ def check_module(mod: LintModule) -> List[Finding]:
     regions: List[Tuple[str, str, ast.AST]] = []
     for fn in _jitted_functions(mod):
         regions.append((RULE_JIT, fn.name, fn))
+    for fn in _pallas_kernel_functions(mod):
+        regions.append((RULE_KERNEL, fn.name, fn))
     for loop in _boundary_loops(mod):
         owner = mod.enclosing_function(loop)
         owner_name = owner.name if owner is not None else "<module>"
@@ -195,10 +257,10 @@ def check_module(mod: LintModule) -> List[Finding]:
                                   f"{region_name}:{sym}",
                 ))
                 continue
-            where = (
-                "a jitted step function" if rule == RULE_JIT
-                else "a flush-boundary hot loop"
-            )
+            where = {
+                RULE_JIT: "a jitted step function",
+                RULE_KERNEL: "a Pallas kernel builder",
+            }.get(rule, "a flush-boundary hot loop")
             findings.append(Finding(
                 rule=rule, file=mod.rel, line=node.lineno,
                 why=(

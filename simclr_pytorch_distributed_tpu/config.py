@@ -94,6 +94,15 @@ class SupConConfig:
     # 'ring' streams contrast blocks around the data axis with ppermute
     # (parallel/collectives.py) for large-global-batch memory scaling
     loss_impl: str = "auto"
+    # conv-block implementation for the encoder's hot path: 'pallas' routes
+    # the stem and the identity-shortcut BasicBlocks through the fused
+    # conv+BN+ReLU residual-block kernels (ops/pallas_conv.py — the
+    # inter-op activation round-trips that fund XLA's stage-1 BN-backward/
+    # residual fusions never touch HBM); 'xla' is the bitwise-pinned
+    # default path; 'auto' picks pallas only on a single-chip TPU mesh at
+    # supported stage geometries (train.supcon.resolve_conv_impl, the
+    # --loss_impl ladder convention, startup banner names the resolution)
+    conv_impl: str = "auto"
     # 'sgd' is the published recipe (util.py:79-84); 'lars' for the
     # large-global-batch configs (SimCLR ImageNet bs=4096, BASELINE configs[4])
     optimizer: str = "sgd"
@@ -341,6 +350,14 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--workdir", type=str, default=d.workdir)
     p.add_argument("--loss_impl", type=str, default=d.loss_impl,
                    choices=["auto", "dense", "fused", "ring"])
+    p.add_argument("--conv_impl", type=str, default=d.conv_impl,
+                   choices=["auto", "xla", "pallas"],
+                   help="encoder conv-block path: fused Pallas "
+                        "conv+BN+ReLU stem/residual-block kernels "
+                        "(ops/pallas_conv.py) vs the bitwise-pinned XLA "
+                        "path; 'auto' = pallas only on a single-chip TPU "
+                        "at supported geometries (startup banner names "
+                        "the resolution)")
     p.add_argument("--optimizer", type=str, default=d.optimizer,
                    choices=["sgd", "lars"],
                    help="lars: layer-adaptive scaling for large global batches")
@@ -518,6 +535,39 @@ def validate_data_placement(dataset: str, data_placement: str) -> None:
         )
 
 
+def validate_conv_impl(cfg: SupConConfig) -> None:
+    """Parse-time check of --conv_impl interactions (the
+    validate_data_placement convention: reject up front what would
+    otherwise silently no-op far from the flag).
+
+    The fused kernels implement fp32 whole-batch train-mode BN only, so an
+    EXPLICIT ``--conv_impl pallas`` together with ``--bf16`` would leave
+    zero admitted sites — the flag would be a silent no-op while the user
+    believes the fused path is on. ``auto`` is allowed to degrade (with
+    the startup banner naming the reason).
+    """
+    if cfg.conv_impl == "pallas" and cfg.bf16:
+        raise ValueError(
+            "--conv_impl pallas requires fp32 compute (the fused kernels "
+            "implement fp32 whole-batch BN; docs/PERF.md round 15) — drop "
+            "--bf16, or use --conv_impl auto, which degrades to xla with "
+            "a banner"
+        )
+
+
+def impl_resolution_banner(
+    flag: str, requested: str, resolved: str, reason: str
+) -> str:
+    """One-line startup banner for an impl-resolution ladder
+    (``--loss_impl`` / ``--conv_impl`` — the data_placement ladder
+    convention): names the RESOLVED implementation and WHY, so a silent
+    degradation (unsupported geometry, non-TPU backend) is discoverable
+    from the log instead of only from the resolution code."""
+    if requested == resolved:
+        return f"[{flag}] '{resolved}': {reason}"
+    return f"[{flag}] requested '{requested}' -> resolved '{resolved}': {reason}"
+
+
 def validate_recipe(cfg: SupConConfig) -> None:
     """Resolve ``--recipe auto`` and check the recipe flag interactions at
     PARSE time (the --ngpu convention: these feed tree geometry and loss
@@ -596,6 +646,7 @@ def parse_supcon(argv=None) -> SupConConfig:
 def finalize_supcon(cfg: SupConConfig, make_dirs: bool = True) -> SupConConfig:
     """Derived fields, replicating main_supcon.py:92-150."""
     validate_data_placement(cfg.dataset, cfg.data_placement)
+    validate_conv_impl(cfg)
     validate_recipe(cfg)
     if cfg.dataset == "path":
         assert cfg.data_folder is not None and cfg.mean is not None and cfg.std is not None

@@ -117,6 +117,10 @@ class SupConResNet(nn.Module):
     bn_group_views: int = 2
     remat: bool = False  # per-block activation remat (models/resnet.py)
     stem: str = "conv"  # "s2d" = repacked stem experiment (models/resnet.py)
+    # "xla" (bitwise-pinned default) or "pallas": fused conv+BN+ReLU stem/
+    # BasicBlock kernels where the geometry admits (models/resnet.py,
+    # ops/pallas_conv.py); resolve via train.supcon.resolve_conv_impl
+    conv_impl: str = "xla"
 
     def setup(self):
         model_fn, dim_in = MODEL_DICT[self.model_name]
@@ -124,7 +128,7 @@ class SupConResNet(nn.Module):
             dtype=self.dtype, axis_name=self.axis_name, sync_bn=self.sync_bn,
             bn_local_groups=self.bn_local_groups,
             bn_group_views=self.bn_group_views,
-            remat=self.remat, stem=self.stem,
+            remat=self.remat, stem=self.stem, conv_impl=self.conv_impl,
         )
         self.proj_head = ProjectionHead(
             head=self.head, dim_in=dim_in, feat_dim=self.feat_dim, dtype=self.dtype

@@ -32,11 +32,75 @@ This module therefore implements plain batch statistics plus:
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+
+
+def running_stats_update(
+    ra_mean: jax.Array, ra_var: jax.Array,
+    batch_mean: jax.Array, batch_var_biased: jax.Array,
+    count: int, momentum: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """The torch-convention running-stat update, single-sourced.
+
+    ``new = (1-m)*old + m*batch`` with the BIASED batch variance rescaled
+    to UNBIASED for the running buffer (torch BatchNorm semantics; module
+    docstring). Shared by ``CrossReplicaBatchNorm`` and the fused Pallas
+    conv path (``FusedTrainBN``), so the two impls cannot drift.
+    """
+    unbiased = batch_var_biased * (count / max(count - 1, 1))
+    m = momentum
+    return (
+        (1.0 - m) * ra_mean + m * batch_mean,
+        (1.0 - m) * ra_var + m * unbiased,
+    )
+
+
+class FusedTrainBN(nn.Module):
+    """Parameter/variable shadow of ``CrossReplicaBatchNorm`` for the fused
+    Pallas conv path (``--conv_impl pallas``, ops/pallas_conv.py).
+
+    The fused kernels compute the batch statistics and the normalization
+    INSIDE the conv kernel, so this module only owns what must live in the
+    Flax tree: the affine params and the running-stat variables, under
+    exactly the names/shapes/inits ``CrossReplicaBatchNorm`` creates — the
+    param tree is impl-independent by construction (a ``--conv_impl
+    pallas`` checkpoint restores under ``--conv_impl xla`` and vice versa).
+
+    Call once with no statistics to fetch ``(scale, bias)`` for the
+    kernel, then AGAIN with the kernel's returned batch moments to apply
+    the running update (``running_stats_update``); train mode only — the
+    eval path stays on the Flax module.
+    """
+
+    features: int
+    momentum: float = 0.1
+
+    @nn.compact
+    def __call__(self, batch_mean=None, batch_var_biased=None, count: int = 0):
+        scale = self.param(
+            "scale", nn.initializers.ones, (self.features,), jnp.float32
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.features,), jnp.float32
+        )
+        ra_mean = self.variable(
+            "batch_stats", "mean",
+            lambda: jnp.zeros((self.features,), jnp.float32),
+        )
+        ra_var = self.variable(
+            "batch_stats", "var",
+            lambda: jnp.ones((self.features,), jnp.float32),
+        )
+        if batch_mean is not None and not self.is_initializing():
+            ra_mean.value, ra_var.value = running_stats_update(
+                ra_mean.value, ra_var.value, batch_mean, batch_var_biased,
+                count, self.momentum,
+            )
+        return scale, bias
 
 
 class CrossReplicaBatchNorm(nn.Module):
@@ -126,10 +190,10 @@ class CrossReplicaBatchNorm(nn.Module):
                 # Running stats track group 0: DDP's broadcast_buffers=True
                 # re-broadcasts rank 0's BN buffers every forward, so rank 0's
                 # local statistics are the persistent ones in the reference.
-                unbiased_var0 = var[0] * (count / max(count - 1, 1))
-                m = self.momentum
-                ra_mean.value = (1.0 - m) * ra_mean.value + m * mean[0]
-                ra_var.value = (1.0 - m) * ra_var.value + m * unbiased_var0
+                ra_mean.value, ra_var.value = running_stats_update(
+                    ra_mean.value, ra_var.value, mean[0], var[0],
+                    count, self.momentum,
+                )
             bshape = (1, g) + (1,) * (xg.ndim - 3) + (num_features,)
             yg = (xg - mean.reshape(bshape)) * jax.lax.rsqrt(
                 var.reshape(bshape) + self.epsilon
@@ -154,10 +218,10 @@ class CrossReplicaBatchNorm(nn.Module):
 
             if not self.is_initializing():
                 # torch running update: biased mean, UNBIASED variance.
-                unbiased_var = var * (count / max(count - 1, 1))
-                m = self.momentum
-                ra_mean.value = (1.0 - m) * ra_mean.value + m * mean
-                ra_var.value = (1.0 - m) * ra_var.value + m * unbiased_var
+                ra_mean.value, ra_var.value = running_stats_update(
+                    ra_mean.value, ra_var.value, mean, var,
+                    count, self.momentum,
+                )
 
         y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon) * scale + bias
         return y.astype(self.dtype or x.dtype)

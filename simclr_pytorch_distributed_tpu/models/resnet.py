@@ -29,10 +29,34 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from simclr_pytorch_distributed_tpu.models.norm import CrossReplicaBatchNorm
+from simclr_pytorch_distributed_tpu.models.norm import (
+    CrossReplicaBatchNorm,
+    FusedTrainBN,
+)
+from simclr_pytorch_distributed_tpu.ops import pallas_conv
 
 # torch nn.init.kaiming_normal_(mode='fan_out', nonlinearity='relu')
 conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class _ConvKernel(nn.Module):
+    """Parameter shadow of ``nn.Conv`` for the fused Pallas path: owns ONLY
+    the ``kernel`` param, under nn.Conv's name/shape/init/param_dtype, so
+    the param tree is impl-independent (``--conv_impl pallas`` checkpoints
+    restore under ``--conv_impl xla`` and vice versa). Init always traces
+    the XLA branch, so this shadow only ever READS the existing param."""
+
+    shape: Tuple[int, ...]
+
+    @nn.compact
+    def __call__(self) -> jax.Array:
+        return self.param("kernel", conv_kernel_init, self.shape, jnp.float32)
+
+
+def _interpret_pallas() -> bool:
+    """Pallas kernels run compiled on TPU, interpreted elsewhere (the CPU
+    parity/test path — slow, for correctness only)."""
+    return jax.default_backend() != "tpu"
 
 
 # torch Conv2d(k=3, padding=1) pads (1,1) on each spatial dim. Flax's default
@@ -51,10 +75,40 @@ class BasicBlock(nn.Module):
     expansion: int = 1
     dtype: Any = jnp.float32
     norm: Callable[..., nn.Module] = CrossReplicaBatchNorm
+    # "pallas": route identity-shortcut train-mode applies through the
+    # fused conv+BN+ReLU residual-block kernel (ops/pallas_conv.py) when
+    # supports_block admits the geometry; everything else (stride-2 /
+    # projection blocks, eval mode, init, unsupported shapes) stays on the
+    # bitwise-pinned XLA path below. The ResNet owner only passes "pallas"
+    # when the BN config is whole-batch (models/norm.py semantics the
+    # kernel implements) and the compute dtype is fp32.
+    conv_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = True):  # train is
         # positional-or-keyword so nn.remat can mark it static (argnum 2)
+        if (
+            self.conv_impl == "pallas"
+            and train
+            and not self.is_initializing()
+            and pallas_conv.supports_block(
+                x.shape[0], x.shape[1], x.shape[2], self.planes,
+                stride=self.stride, in_channels=x.shape[-1],
+            )
+        ):
+            k1 = _ConvKernel((3, 3, x.shape[-1], self.planes), name="Conv_0")()
+            k2 = _ConvKernel((3, 3, self.planes, self.planes), name="Conv_1")()
+            bn1 = FusedTrainBN(self.planes, name="bn1")
+            bn2 = FusedTrainBN(self.planes, name="bn2")
+            g1, b1 = bn1()
+            g2, b2 = bn2()
+            out, m1, v1, m2, v2 = pallas_conv.fused_basic_block(
+                x, k1, g1, b1, k2, g2, b2, interpret=_interpret_pallas()
+            )
+            count = x.shape[0] * x.shape[1] * x.shape[2]
+            bn1(m1, v1, count)  # running-stat update (second call)
+            bn2(m2, v2, count)
+            return out.astype(self.dtype)
         norm = partial(self.norm, use_running_average=not train)
         conv = partial(
             nn.Conv, use_bias=False, kernel_init=conv_kernel_init, dtype=self.dtype,
@@ -86,6 +140,11 @@ class Bottleneck(nn.Module):
     expansion: int = 4
     dtype: Any = jnp.float32
     norm: Callable[..., nn.Module] = CrossReplicaBatchNorm
+    # accepted for ctor uniformity with BasicBlock but IGNORED: the fused
+    # kernel implements the 3x3+3x3 BasicBlock only — the bottleneck's
+    # 1x1-3x3-1x1 chain (three BN stages) is the recorded open edge
+    # (docs/PERF.md round 15); rn50-family blocks always take the XLA path
+    conv_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = True):  # train is
@@ -140,6 +199,13 @@ class ResNet(nn.Module):
     # each block's activations instead of keeping them in HBM — the standard
     # FLOPs-for-memory trade for bigger per-chip batches (identical numerics)
     remat: bool = False
+    # "xla" (default, bitwise-pinned) or "pallas": fused conv+BN+ReLU
+    # kernels (ops/pallas_conv.py) for the stem and the identity-shortcut
+    # BasicBlocks whose geometry supports_block/supports_stem admit; only
+    # effective in train mode under whole-batch BN statistics and fp32
+    # compute — everything else falls back per-site to the XLA path.
+    # Resolve from the --conv_impl flag via train.supcon.resolve_conv_impl.
+    conv_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
@@ -152,7 +218,35 @@ class ResNet(nn.Module):
             if self.remat else self.block_cls
         )
         x = x.astype(self.dtype)
-        if self.stem == "s2d":
+        # fused kernels implement whole-batch fp32 train-mode BN only: the
+        # grouped per-device mode (sync=False, local_groups>1) and explicit
+        # axis_name reductions stay on the Flax path (models/norm.py)
+        fused_ok = (
+            self.conv_impl == "pallas"
+            and self.axis_name is None
+            and (self.sync_bn or self.bn_local_groups == 1)
+            and self.dtype == jnp.float32
+        )
+        block_conv_impl = "pallas" if fused_ok else "xla"
+        if (
+            fused_ok
+            and self.stem == "conv"
+            and train
+            and not self.is_initializing()
+            and pallas_conv.supports_stem(
+                x.shape[0], x.shape[1], x.shape[2], x.shape[3], 64
+            )
+        ):
+            kernel = _ConvKernel((3, 3, x.shape[-1], 64), name="conv1")()
+            bn1 = FusedTrainBN(64, name="bn1")
+            g, b = bn1()
+            x, m, v = pallas_conv.fused_conv_bn_relu(
+                x, kernel, g, b, interpret=_interpret_pallas()
+            )
+            count = x.shape[0] * x.shape[1] * x.shape[2]
+            bn1(m, v, count)  # running-stat update (second call)
+            x = x.astype(self.dtype)
+        elif self.stem == "s2d":
             n, h, w, c = x.shape
             x = x.reshape(n, h // 2, 2, w // 2, 2, c)
             x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
@@ -169,7 +263,9 @@ class ResNet(nn.Module):
                 kernel_init=conv_kernel_init, dtype=self.dtype,
                 param_dtype=jnp.float32, name="conv1",
             )(x)
-        x = nn.relu(norm(use_running_average=not train, name="bn1")(x))
+            x = nn.relu(norm(use_running_average=not train, name="bn1")(x))
+        if self.stem == "s2d":
+            x = nn.relu(norm(use_running_average=not train, name="bn1")(x))
         widths = (64, 128, 256, 512)
         strides = (1, 2, 2, 2)
         for stage, (n_blocks, width, stage_stride) in enumerate(
@@ -181,6 +277,7 @@ class ResNet(nn.Module):
                     stride=stage_stride if block == 0 else 1,
                     dtype=self.dtype,
                     norm=norm,
+                    conv_impl=block_conv_impl,
                     name=f"layer{stage + 1}_block{block}",
                 )(x, train)
         x = jnp.mean(x, axis=(1, 2))  # global average pool (AdaptiveAvgPool2d((1,1)))

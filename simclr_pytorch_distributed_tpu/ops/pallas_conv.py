@@ -1,0 +1,690 @@
+"""Fused Pallas TPU kernels for the CIFAR-geometry ResNet conv blocks.
+
+WHY: the compiled step is bandwidth-bound at 0.85 of its measured mixed
+roofline, and the residual lives in XLA's conv emitter — conv fusions carry
+82% of step time (49.0 of 59.5 ms) at 69% of peak HBM BW, with the stage-1
+BN-backward and residual/ReLU fusions topping the per-fusion traffic table
+(docs/PERF.md round 4, ``docs/evidence/xplane_bw_r4.json`` fusion.81/74/75
+and the fusion.160/161/162 trio). PERF.md's own conclusion: raising MFU
+"requires reducing bytes, not faster matmuls". At 32x32 the activations are
+too thin per byte for XLA's generic conv emitter, and every inter-op
+boundary (conv -> BN stats -> normalize/ReLU -> conv -> BN -> residual add)
+funds a full HBM round trip of a ``[2B, H, W, C]`` activation array.
+
+WHAT: two fused ops that keep those boundaries in VMEM/registers —
+
+- ``fused_conv_bn_relu``: the ResNet stem (conv3x3/s1 + train-mode BN +
+  ReLU) as one kernel;
+- ``fused_basic_block``: the identity-shortcut BasicBlock
+  (conv3x3 -> BN -> ReLU -> conv3x3 -> BN -> +residual -> ReLU) as one
+  kernel, forward and custom-VJP backward.
+
+HOW: the conv is an MXU matmul over VMEM-resident im2col tiles (the
+crop-as-matmul precedent, docs/PERF.md 227x): each 3x3 window offset is one
+``[bn*H*W, Cin] @ [Cin, Cout]`` contraction against a spatially-shifted
+slice of a zero-padded VMEM scratch tile. Train-mode BN needs batch
+statistics BEFORE it can normalize, so each kernel runs a sequential
+PHASE-major grid ``(phases, batch_tiles)`` over the same input tiles:
+stats phases accumulate per-channel sums in VMEM scratch and the emit
+phase recomputes the convs in-register with the now-known scale/shift —
+a FLOPs-for-bytes trade (the convs here are bandwidth-bound, the MXU is
+62% idle). Per-activation-array HBM traffic of the block forward drops
+from the ~9 traversals XLA's fusion decomposition pays to
+``FWD_HBM_TRAVERSALS_BLOCK`` (3 reads of x + 1 write of out); the backward
+keeps only O(C) residuals (saved batch moments) and recomputes everything
+else, ``BWD_HBM_TRAVERSALS_BLOCK`` vs the ~12 of the separate BN-backward /
+conv-backward / residual fusions.
+
+BN semantics are models/norm.py's torch-matching whole-batch train mode:
+biased variance for normalization, fp32 statistics, running-stat update
+(UNBIASED variance, momentum-weighted) applied by the caller
+(``models.norm.running_stats_update``) from the returned batch moments —
+the kernels never touch running stats. Cross-replica semantics are
+preserved by construction: the kernel computes stats over exactly the
+array it is given (per-device = whole batch on the single-chip mesh the
+resolution ladder admits; grouped/multi-device BN configurations are
+gated off in ``supports_block``/``resolve_conv_impl``).
+
+The VJP treats the returned batch moments as ancillary (their cotangents
+are discarded): they feed only the mutable running-stat buffers, exactly
+like Flax's BN variables, while the normalization statistics' gradient
+contribution is fully inside the standard train-mode BN backward the
+kernel implements.
+
+``interpret=True`` runs the Pallas interpreter — the CPU path used by the
+tier-1 parity suite (tests/test_pallas_conv.py) and by ``--conv_impl
+pallas`` on non-TPU backends (slow; for tests and the checkpoint
+round-trip smoke, not for training throughput).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Per-activation-array HBM traversals of ONE block apply, by path. The
+# Pallas counts are properties of the kernels' BlockSpecs below (each
+# phase re-reads its input tiles; outputs are written once via the
+# phase-gated index maps); the XLA counts are read off the round-4 xplane
+# fusion decomposition (docs/PERF.md: conv kernel writes y1; BN-stat
+# fusion reads y1; normalize+ReLU fusion reads y1, writes a1; conv reads
+# a1, writes y2; BN-stat reads y2; normalize+residual+ReLU fusion reads
+# y2 + x, writes out — and the backward's fusion.81/74/75-class stat +
+# dx chains). scripts/convblock_ab.py's CPU proxy injects one modeled
+# delay per traversal; docs/PERF.md round 15 carries the derivation.
+FWD_HBM_TRAVERSALS_BLOCK = 4   # 3 phase-reads of x + 1 write of out
+FWD_HBM_TRAVERSALS_XLA = 9    # see derivation above
+BWD_HBM_TRAVERSALS_BLOCK = 7   # 3 reads of x + 3 reads of g + 1 write of dx
+BWD_HBM_TRAVERSALS_XLA = 12   # BN-bwd stat reads x2, dx chains, residual adds
+
+# VMEM budget the geometry gate admits against (bytes). Deliberately
+# conservative vs the ~16 MB/core physical VMEM: the estimate below is a
+# model of the kernel's resident set, not the compiler's exact allocation.
+VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _pick_batch_tile(n: int, h: int, w: int, cin: int, cout: int,
+                     *, residual: bool) -> Optional[int]:
+    """Largest batch-tile size (<= 8) dividing ``n`` whose estimated VMEM
+    resident set fits the budget, or None."""
+    for bn in (8, 4, 2, 1):
+        if n % bn:
+            continue
+        if _vmem_estimate(bn, h, w, cin, cout, residual=residual) <= VMEM_BUDGET:
+            return bn
+    return None
+
+
+def _vmem_estimate(bn: int, h: int, w: int, cin: int, cout: int,
+                   *, residual: bool) -> int:
+    """Modeled peak VMEM bytes of the WORST kernel (the backward) at this
+    geometry: padded scratch tiles, weight blocks (incl. the flipped
+    copies), dW accumulators, and a conservative multiplier for the
+    per-step activation values the compiler keeps live."""
+    pad = bn * (h + 2) * (w + 2) * 4
+    tile = bn * h * w * 4
+    if not residual:  # stem: one conv, cin != cout
+        pads = 2 * pad * max(cin, cout)  # xpad + gpad
+        weights = 2 * 9 * cin * cout * 4  # k + kt
+        dw_acc = 9 * cin * cout * 4
+        live = 6 * tile * max(cin, cout)
+    else:  # basic block: two cin==cout convs
+        pads = 3 * pad * cout            # xpad + apad + gpad
+        weights = 4 * 9 * cout * cout * 4  # k1, k2, k1t, k2t
+        dw_acc = 2 * 9 * cout * cout * 4
+        live = 8 * tile * cout
+    return pads + weights + dw_acc + live
+
+
+def supports_block(n: int, h: int, w: int, c: int, *, stride: int = 1,
+                   in_channels: Optional[int] = None) -> bool:
+    """True if the fused BasicBlock kernel admits this geometry: identity
+    shortcut (stride 1, in==out channels), spatial dims that the padded
+    3x3 window covers, and a batch tile whose resident set fits VMEM."""
+    if stride != 1 or (in_channels is not None and in_channels != c):
+        return False
+    if h < 3 or w < 3 or n < 1 or c < 1:
+        return False
+    return _pick_batch_tile(n, h, w, c, c, residual=True) is not None
+
+
+def supports_stem(n: int, h: int, w: int, cin: int, cout: int) -> bool:
+    """True if the fused stem kernel admits this geometry (conv3x3/s1)."""
+    if h < 3 or w < 3 or n < 1 or cin < 1 or cout < 1:
+        return False
+    return _pick_batch_tile(n, h, w, cin, cout, residual=False) is not None
+
+
+def _vmem_spec(block_shape=None, index_map=None):
+    if block_shape is None:
+        return pl.BlockSpec(memory_space=pltpu.VMEM)
+    return pl.BlockSpec(block_shape, index_map, memory_space=pltpu.VMEM)
+
+
+def _fill_pad(pad_ref, x):
+    """Zero-pad ``x`` by 1 pixel on each spatial edge into VMEM scratch."""
+    pad_ref[:] = jnp.zeros(pad_ref.shape, jnp.float32)
+    pad_ref[:, 1:-1, 1:-1, :] = x
+
+
+def _conv3x3(pad_ref, w, h: int, wdt: int):
+    """3x3/s1 conv as 9 shifted MXU matmuls over the padded VMEM tile.
+
+    ``pad_ref``: scratch ref ``[bn, h+2, w+2, cin]`` (already filled);
+    ``w``: kernel VALUE ``[3, 3, cin, cout]``. Each window offset is one
+    ``[bn*h*w, cin] @ [cin, cout]`` contraction — the im2col matrix is
+    never materialized, only its shifted views are read back out of the
+    same padded tile.
+    """
+    bn, _, _, cin = pad_ref.shape
+    cout = w.shape[3]
+    acc = None
+    for di in range(3):
+        for dj in range(3):
+            xs = pad_ref[:, di:di + h, dj:dj + wdt, :].reshape(bn * h * wdt, cin)
+            t = jnp.dot(xs, w[di, dj], preferred_element_type=jnp.float32)
+            acc = t if acc is None else acc + t
+    return acc.reshape(bn, h, wdt, cout)
+
+
+def _dw_accumulate(dw_ref, pad_ref, dy, h: int, wdt: int):
+    """dW[di,dj] += x_window(di,dj)^T @ dy for all 9 offsets, into the
+    ``[9*cin, cout]`` scratch accumulator."""
+    bn, _, _, cin = pad_ref.shape
+    cout = dy.shape[3]
+    dyf = dy.reshape(bn * h * wdt, cout)
+    for di in range(3):
+        for dj in range(3):
+            xs = pad_ref[:, di:di + h, dj:dj + wdt, :].reshape(bn * h * wdt, cin)
+            k = di * 3 + dj
+            dw_ref[k * cin:(k + 1) * cin, :] += jnp.dot(
+                xs.T, dyf, preferred_element_type=jnp.float32
+            )
+
+
+def _channel_sums(v, c: int):
+    """``(1, C)`` per-channel sum over (batch-tile, H, W)."""
+    return jnp.sum(v.reshape(-1, c), axis=0, keepdims=True)
+
+
+def _flip_transpose(k):
+    """Spatially-flipped, channel-transposed kernel: the weight of the
+    transposed conv that computes dx from dy (computed OUTSIDE the kernel;
+    O(9*Cin*Cout) bytes)."""
+    return jnp.transpose(k[::-1, ::-1, :, :], (0, 1, 3, 2))
+
+
+# ---------------------------------------------------------------------------
+# Fused stem: conv3x3/s1 + train-mode BN + ReLU.
+# ---------------------------------------------------------------------------
+
+
+def _stem_fwd_kernel(
+    x_ref, k_ref, g_ref, b_ref,
+    out_ref, m_ref, v_ref,
+    xpad, acc_s, acc_q, sc_s, sc_t,
+    *, h: int, w: int, count: float, eps: float,
+):
+    p = pl.program_id(0)
+    i = pl.program_id(1)
+    cout = out_ref.shape[3]
+
+    @pl.when((p == 0) & (i == 0))
+    def _():
+        acc_s[:] = jnp.zeros_like(acc_s)
+        acc_q[:] = jnp.zeros_like(acc_q)
+
+    # stage-1 finalize: batch moments -> folded scale/shift, once, before
+    # the first emit-phase tile consumes them
+    @pl.when((p == 1) & (i == 0))
+    def _():
+        m = acc_s[:] / count
+        v = acc_q[:] / count - m * m  # biased (norm.py convention)
+        m_ref[:] = m
+        v_ref[:] = v
+        s = g_ref[:] * jax.lax.rsqrt(v + eps)
+        sc_s[:] = s
+        sc_t[:] = b_ref[:] - m * s
+
+    _fill_pad(xpad, x_ref[:].astype(jnp.float32))
+    y = _conv3x3(xpad, k_ref[:], h, w)
+
+    @pl.when(p == 0)
+    def _():
+        acc_s[:] += _channel_sums(y, cout)
+        acc_q[:] += _channel_sums(jnp.square(y), cout)
+
+    @pl.when(p == 1)
+    def _():
+        out_ref[:] = jnp.maximum(y * sc_s[:] + sc_t[:], 0.0)
+
+
+def _stem_bwd_kernel(
+    x_ref, k_ref, kt_ref, g_ref, b_ref, m_ref, v_ref, gout_ref,
+    dx_ref, dw_ref, dg_ref, db_ref,
+    xpad, gpad, dw_acc, acc_db, acc_dg,
+    *, h: int, w: int, count: float, eps: float,
+):
+    p = pl.program_id(0)
+    i = pl.program_id(1)
+    nt = pl.num_programs(1)
+    cin = x_ref.shape[3]
+
+    @pl.when((p == 0) & (i == 0))
+    def _():
+        acc_db[:] = jnp.zeros_like(acc_db)
+        acc_dg[:] = jnp.zeros_like(acc_dg)
+        dw_acc[:] = jnp.zeros_like(dw_acc)
+
+    # recompute the tile's forward from the saved batch moments
+    m, v, g = m_ref[:], v_ref[:], g_ref[:]
+    rs = jax.lax.rsqrt(v + eps)
+    _fill_pad(xpad, x_ref[:].astype(jnp.float32))
+    y = _conv3x3(xpad, k_ref[:], h, w)
+    yh = (y - m) * rs
+    pre = yh * g + b_ref[:]
+    dp = gout_ref[:].astype(jnp.float32) * (pre > 0.0)
+
+    @pl.when(p == 0)
+    def _():
+        acc_db[:] += _channel_sums(dp, dp.shape[3])
+        acc_dg[:] += _channel_sums(dp * yh, dp.shape[3])
+
+    @pl.when(p == 1)
+    def _():
+        # standard train-mode BN backward (biased variance): the batch
+        # moments' own gradient contribution is the two mean-subtractions
+        dy = rs * g * (dp - acc_db[:] / count - yh * acc_dg[:] / count)
+        _dw_accumulate(dw_acc, xpad, dy, h, w)
+        _fill_pad(gpad, dy)
+        dx_ref[:] = _conv3x3(gpad, kt_ref[:], h, w)
+
+    @pl.when((p == 1) & (i == nt - 1))
+    def _():
+        dw_ref[:] = dw_acc[:].reshape(3, 3, cin, dw_ref.shape[3])
+        dg_ref[:] = acc_dg[:]
+        db_ref[:] = acc_db[:]
+
+
+def _stem_call(x, k, g, b, eps, interpret, bn):
+    n, h, w, cin = x.shape
+    cout = k.shape[3]
+    nt = n // bn
+    count = float(n * h * w)
+    kernel = functools.partial(
+        _stem_fwd_kernel, h=h, w=w, count=count, eps=eps
+    )
+    tile = _vmem_spec((bn, h, w, cin), lambda p, i: (i, 0, 0, 0))
+    out_tile = _vmem_spec(
+        (bn, h, w, cout), lambda p, i: ((p == 1) * i, 0, 0, 0)
+    )
+    full = _vmem_spec((3, 3, cin, cout), lambda p, i: (0, 0, 0, 0))
+    row = _vmem_spec((1, cout), lambda p, i: (0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(2, nt),
+        in_specs=[tile, full, row, row],
+        out_specs=[out_tile, row, row],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, w, cout), jnp.float32),
+            jax.ShapeDtypeStruct((1, cout), jnp.float32),
+            jax.ShapeDtypeStruct((1, cout), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bn, h + 2, w + 2, cin), jnp.float32),
+            pltpu.VMEM((1, cout), jnp.float32),
+            pltpu.VMEM((1, cout), jnp.float32),
+            pltpu.VMEM((1, cout), jnp.float32),
+            pltpu.VMEM((1, cout), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, k, g[None, :], b[None, :])
+
+
+def _stem_bwd_call(x, k, g, b, m, v, gout, eps, interpret, bn):
+    n, h, w, cin = x.shape
+    cout = k.shape[3]
+    nt = n // bn
+    count = float(n * h * w)
+    kernel = functools.partial(
+        _stem_bwd_kernel, h=h, w=w, count=count, eps=eps
+    )
+    in_tile = _vmem_spec((bn, h, w, cin), lambda p, i: (i, 0, 0, 0))
+    g_tile = _vmem_spec((bn, h, w, cout), lambda p, i: (i, 0, 0, 0))
+    dx_tile = _vmem_spec(
+        (bn, h, w, cin), lambda p, i: ((p == 1) * i, 0, 0, 0)
+    )
+    kfull = _vmem_spec((3, 3, cin, cout), lambda p, i: (0, 0, 0, 0))
+    ktfull = _vmem_spec((3, 3, cout, cin), lambda p, i: (0, 0, 0, 0))
+    row = _vmem_spec((1, cout), lambda p, i: (0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(2, nt),
+        in_specs=[in_tile, kfull, ktfull, row, row, row, row, g_tile],
+        out_specs=[dx_tile, kfull, row, row],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, w, cin), jnp.float32),
+            jax.ShapeDtypeStruct((3, 3, cin, cout), jnp.float32),
+            jax.ShapeDtypeStruct((1, cout), jnp.float32),
+            jax.ShapeDtypeStruct((1, cout), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bn, h + 2, w + 2, cin), jnp.float32),
+            pltpu.VMEM((bn, h + 2, w + 2, cout), jnp.float32),
+            pltpu.VMEM((9 * cin, cout), jnp.float32),
+            pltpu.VMEM((1, cout), jnp.float32),
+            pltpu.VMEM((1, cout), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        x, k, _flip_transpose(k), g[None, :], b[None, :],
+        m[None, :], v[None, :], gout,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _stem(x, k, g, b, eps, interpret, bn):
+    out, _ = _stem_fwd(x, k, g, b, eps, interpret, bn)
+    return out
+
+
+def _stem_fwd(x, k, g, b, eps, interpret, bn):
+    out, m, v = _stem_call(x, k, g, b, eps, interpret, bn)
+    return (out, m[0], v[0]), (x, k, g, b, m[0], v[0])
+
+
+def _stem_bwd(eps, interpret, bn, res, ct):
+    x, k, g, b, m, v = res
+    gout = ct[0]  # batch-moment cotangents discarded (module docstring)
+    dx, dw, dg, db = _stem_bwd_call(x, k, g, b, m, v, gout, eps, interpret, bn)
+    return dx, dw, dg[0], db[0]
+
+
+_stem.defvjp(_stem_fwd, _stem_bwd)
+
+
+def fused_conv_bn_relu(
+    x: jax.Array, kernel: jax.Array, scale: jax.Array, bias: jax.Array,
+    *, eps: float = 1e-5, interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused stem: ``relu(bn_train(conv3x3_s1(x, kernel)))`` in one kernel.
+
+    Returns ``(out, batch_mean, batch_var_biased)``; the caller applies the
+    running-stat update (``models.norm.running_stats_update``). Gradients
+    flow to ``x``/``kernel``/``scale``/``bias``; the returned moments are
+    ancillary (zero cotangent, like Flax BN variables).
+    """
+    n, h, w, cin = x.shape
+    cout = kernel.shape[3]
+    bn = _pick_batch_tile(n, h, w, cin, cout, residual=False)
+    if bn is None:
+        raise ValueError(
+            f"fused stem does not admit geometry [{n},{h},{w},{cin}]->{cout}"
+            " (supports_stem gate)"
+        )
+    return _stem(
+        x.astype(jnp.float32), kernel.astype(jnp.float32),
+        scale.astype(jnp.float32), bias.astype(jnp.float32),
+        float(eps), bool(interpret), bn,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused BasicBlock: conv-BN-ReLU-conv-BN-(+x)-ReLU, identity shortcut.
+# ---------------------------------------------------------------------------
+
+
+def _block_fwd_kernel(
+    x_ref, k1_ref, k2_ref, g1_ref, b1_ref, g2_ref, b2_ref,
+    out_ref, m1_ref, v1_ref, m2_ref, v2_ref,
+    xpad, apad, acc1s, acc1q, acc2s, acc2q, scA, shA, scB, shB,
+    *, h: int, w: int, count: float, eps: float,
+):
+    p = pl.program_id(0)
+    i = pl.program_id(1)
+    c = out_ref.shape[3]
+
+    @pl.when((p == 0) & (i == 0))
+    def _():
+        acc1s[:] = jnp.zeros_like(acc1s)
+        acc1q[:] = jnp.zeros_like(acc1q)
+        acc2s[:] = jnp.zeros_like(acc2s)
+        acc2q[:] = jnp.zeros_like(acc2q)
+
+    # stage-1 stats finalize (before the first phase-1 tile reads scA/shA)
+    @pl.when((p == 1) & (i == 0))
+    def _():
+        m = acc1s[:] / count
+        v = acc1q[:] / count - m * m
+        m1_ref[:] = m
+        v1_ref[:] = v
+        s = g1_ref[:] * jax.lax.rsqrt(v + eps)
+        scA[:] = s
+        shA[:] = b1_ref[:] - m * s
+
+    # stage-2 stats finalize (before the first phase-2 tile reads scB/shB)
+    @pl.when((p == 2) & (i == 0))
+    def _():
+        m = acc2s[:] / count
+        v = acc2q[:] / count - m * m
+        m2_ref[:] = m
+        v2_ref[:] = v
+        s = g2_ref[:] * jax.lax.rsqrt(v + eps)
+        scB[:] = s
+        shB[:] = b2_ref[:] - m * s
+
+    x = x_ref[:].astype(jnp.float32)
+    _fill_pad(xpad, x)
+    y1 = _conv3x3(xpad, k1_ref[:], h, w)
+
+    @pl.when(p == 0)
+    def _():
+        acc1s[:] += _channel_sums(y1, c)
+        acc1q[:] += _channel_sums(jnp.square(y1), c)
+
+    @pl.when(p >= 1)
+    def _():
+        a1 = jnp.maximum(y1 * scA[:] + shA[:], 0.0)
+        _fill_pad(apad, a1)
+        y2 = _conv3x3(apad, k2_ref[:], h, w)
+
+        @pl.when(p == 1)
+        def _():
+            acc2s[:] += _channel_sums(y2, c)
+            acc2q[:] += _channel_sums(jnp.square(y2), c)
+
+        @pl.when(p == 2)
+        def _():
+            out_ref[:] = jnp.maximum(y2 * scB[:] + shB[:] + x, 0.0)
+
+
+def _block_bwd_kernel(
+    x_ref, k1_ref, k2_ref, k1t_ref, k2t_ref,
+    g1_ref, b1_ref, g2_ref, b2_ref,
+    m1_ref, v1_ref, m2_ref, v2_ref, gout_ref,
+    dx_ref, dw1_ref, dw2_ref, dg1_ref, db1_ref, dg2_ref, db2_ref,
+    xpad, apad, gpad, dw1_acc, dw2_acc, s_dz, s_dzy, s_dp, s_dpy,
+    *, h: int, w: int, count: float, eps: float,
+):
+    p = pl.program_id(0)
+    i = pl.program_id(1)
+    nt = pl.num_programs(1)
+    c = x_ref.shape[3]
+
+    @pl.when((p == 0) & (i == 0))
+    def _():
+        s_dz[:] = jnp.zeros_like(s_dz)
+        s_dzy[:] = jnp.zeros_like(s_dzy)
+        s_dp[:] = jnp.zeros_like(s_dp)
+        s_dpy[:] = jnp.zeros_like(s_dpy)
+        dw1_acc[:] = jnp.zeros_like(dw1_acc)
+        dw2_acc[:] = jnp.zeros_like(dw2_acc)
+
+    # recompute the tile's whole forward from the saved batch moments —
+    # the FLOPs-for-bytes trade: no activation residual was ever stored
+    g1, g2 = g1_ref[:], g2_ref[:]
+    rs1 = jax.lax.rsqrt(v1_ref[:] + eps)
+    rs2 = jax.lax.rsqrt(v2_ref[:] + eps)
+    x = x_ref[:].astype(jnp.float32)
+    _fill_pad(xpad, x)
+    y1 = _conv3x3(xpad, k1_ref[:], h, w)
+    yh1 = (y1 - m1_ref[:]) * rs1
+    p1 = yh1 * g1 + b1_ref[:]
+    a1 = jnp.maximum(p1, 0.0)
+    _fill_pad(apad, a1)
+    y2 = _conv3x3(apad, k2_ref[:], h, w)
+    yh2 = (y2 - m2_ref[:]) * rs2
+    z = yh2 * g2 + b2_ref[:] + x
+    dz = gout_ref[:].astype(jnp.float32) * (z > 0.0)
+
+    @pl.when(p == 0)
+    def _():
+        s_dz[:] += _channel_sums(dz, c)
+        s_dzy[:] += _channel_sums(dz * yh2, c)
+
+    @pl.when(p >= 1)
+    def _():
+        # train-mode BN2 backward, then back through conv2 to the stage-1
+        # pre-activation
+        dy2 = rs2 * g2 * (dz - s_dz[:] / count - yh2 * s_dzy[:] / count)
+
+        @pl.when(p == 1)
+        def _():
+            _dw_accumulate(dw2_acc, apad, dy2, h, w)
+
+        _fill_pad(gpad, dy2)
+        da1 = _conv3x3(gpad, k2t_ref[:], h, w)
+        dp1 = da1 * (p1 > 0.0)
+
+        @pl.when(p == 1)
+        def _():
+            s_dp[:] += _channel_sums(dp1, c)
+            s_dpy[:] += _channel_sums(dp1 * yh1, c)
+
+        @pl.when(p == 2)
+        def _():
+            dy1 = rs1 * g1 * (dp1 - s_dp[:] / count - yh1 * s_dpy[:] / count)
+            _dw_accumulate(dw1_acc, xpad, dy1, h, w)
+            _fill_pad(gpad, dy1)
+            # residual shortcut gradient + conv1 transpose
+            dx_ref[:] = dz + _conv3x3(gpad, k1t_ref[:], h, w)
+
+    @pl.when((p == 2) & (i == nt - 1))
+    def _():
+        dw1_ref[:] = dw1_acc[:].reshape(3, 3, c, c)
+        dw2_ref[:] = dw2_acc[:].reshape(3, 3, c, c)
+        dg1_ref[:] = s_dpy[:]
+        db1_ref[:] = s_dp[:]
+        dg2_ref[:] = s_dzy[:]
+        db2_ref[:] = s_dz[:]
+
+
+def _block_call(x, k1, g1, b1, k2, g2, b2, eps, interpret, bn):
+    n, h, w, c = x.shape
+    nt = n // bn
+    count = float(n * h * w)
+    kernel = functools.partial(
+        _block_fwd_kernel, h=h, w=w, count=count, eps=eps
+    )
+    tile = _vmem_spec((bn, h, w, c), lambda p, i: (i, 0, 0, 0))
+    out_tile = _vmem_spec(
+        (bn, h, w, c), lambda p, i: ((p == 2) * i, 0, 0, 0)
+    )
+    kfull = _vmem_spec((3, 3, c, c), lambda p, i: (0, 0, 0, 0))
+    row = _vmem_spec((1, c), lambda p, i: (0, 0))
+    row_out = [row] * 4
+    return pl.pallas_call(
+        kernel,
+        grid=(3, nt),
+        in_specs=[tile, kfull, kfull, row, row, row, row],
+        out_specs=[out_tile] + row_out,
+        out_shape=[jax.ShapeDtypeStruct((n, h, w, c), jnp.float32)]
+        + [jax.ShapeDtypeStruct((1, c), jnp.float32)] * 4,
+        scratch_shapes=[
+            pltpu.VMEM((bn, h + 2, w + 2, c), jnp.float32),
+            pltpu.VMEM((bn, h + 2, w + 2, c), jnp.float32),
+        ] + [pltpu.VMEM((1, c), jnp.float32)] * 8,
+        interpret=interpret,
+    )(x, k1, k2, g1[None, :], b1[None, :], g2[None, :], b2[None, :])
+
+
+def _block_bwd_call(
+    x, k1, g1, b1, k2, g2, b2, m1, v1, m2, v2, gout, eps, interpret, bn
+):
+    n, h, w, c = x.shape
+    nt = n // bn
+    count = float(n * h * w)
+    kernel = functools.partial(
+        _block_bwd_kernel, h=h, w=w, count=count, eps=eps
+    )
+    tile = _vmem_spec((bn, h, w, c), lambda p, i: (i, 0, 0, 0))
+    dx_tile = _vmem_spec(
+        (bn, h, w, c), lambda p, i: ((p == 2) * i, 0, 0, 0)
+    )
+    kfull = _vmem_spec((3, 3, c, c), lambda p, i: (0, 0, 0, 0))
+    row = _vmem_spec((1, c), lambda p, i: (0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(3, nt),
+        in_specs=[tile, kfull, kfull, kfull, kfull,
+                  row, row, row, row, row, row, row, row, tile],
+        out_specs=[dx_tile, kfull, kfull, row, row, row, row],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, w, c), jnp.float32),
+            jax.ShapeDtypeStruct((3, 3, c, c), jnp.float32),
+            jax.ShapeDtypeStruct((3, 3, c, c), jnp.float32),
+        ] + [jax.ShapeDtypeStruct((1, c), jnp.float32)] * 4,
+        scratch_shapes=[
+            pltpu.VMEM((bn, h + 2, w + 2, c), jnp.float32),
+            pltpu.VMEM((bn, h + 2, w + 2, c), jnp.float32),
+            pltpu.VMEM((bn, h + 2, w + 2, c), jnp.float32),
+            pltpu.VMEM((9 * c, c), jnp.float32),
+            pltpu.VMEM((9 * c, c), jnp.float32),
+        ] + [pltpu.VMEM((1, c), jnp.float32)] * 4,
+        interpret=interpret,
+    )(
+        x, k1, k2, _flip_transpose(k1), _flip_transpose(k2),
+        g1[None, :], b1[None, :], g2[None, :], b2[None, :],
+        m1[None, :], v1[None, :], m2[None, :], v2[None, :], gout,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
+def _block(x, k1, g1, b1, k2, g2, b2, eps, interpret, bn):
+    out, _ = _block_fwd(x, k1, g1, b1, k2, g2, b2, eps, interpret, bn)
+    return out
+
+
+def _block_fwd(x, k1, g1, b1, k2, g2, b2, eps, interpret, bn):
+    out, m1, v1, m2, v2 = _block_call(
+        x, k1, g1, b1, k2, g2, b2, eps, interpret, bn
+    )
+    res = (x, k1, g1, b1, k2, g2, b2, m1[0], v1[0], m2[0], v2[0])
+    return (out, m1[0], v1[0], m2[0], v2[0]), res
+
+
+def _block_bwd(eps, interpret, bn, res, ct):
+    x, k1, g1, b1, k2, g2, b2, m1, v1, m2, v2 = res
+    gout = ct[0]  # batch-moment cotangents discarded (module docstring)
+    dx, dw1, dw2, dg1, db1, dg2, db2 = _block_bwd_call(
+        x, k1, g1, b1, k2, g2, b2, m1, v1, m2, v2, gout, eps, interpret, bn
+    )
+    return dx, dw1, dg1[0], db1[0], dw2, dg2[0], db2[0]
+
+
+_block.defvjp(_block_fwd, _block_bwd)
+
+
+def fused_basic_block(
+    x: jax.Array,
+    kernel1: jax.Array, scale1: jax.Array, bias1: jax.Array,
+    kernel2: jax.Array, scale2: jax.Array, bias2: jax.Array,
+    *, eps: float = 1e-5, interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fused identity-shortcut BasicBlock, train mode, one kernel each way.
+
+    ``relu(bn2(conv3x3(relu(bn1(conv3x3(x, k1))), k2)) + x)`` with both BNs
+    in whole-batch train mode. Returns
+    ``(out, mean1, var1_biased, mean2, var2_biased)``; the caller applies
+    the running-stat updates. Differentiable w.r.t. every array argument
+    (custom VJP; the backward kernel recomputes the forward per phase and
+    stores no activation residual — only the O(C) batch moments).
+    """
+    n, h, w, c = x.shape
+    if not supports_block(n, h, w, c):
+        raise ValueError(
+            f"fused basic block does not admit geometry [{n},{h},{w},{c}] "
+            "(supports_block gate)"
+        )
+    bn = _pick_batch_tile(n, h, w, c, c, residual=True)
+    f32 = jnp.float32
+    return _block(
+        x.astype(f32), kernel1.astype(f32), scale1.astype(f32),
+        bias1.astype(f32), kernel2.astype(f32), scale2.astype(f32),
+        bias2.astype(f32), float(eps), bool(interpret), bn,
+    )

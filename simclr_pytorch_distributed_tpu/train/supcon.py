@@ -24,6 +24,7 @@ import logging
 import math
 import os
 import time
+from typing import List
 
 import jax
 import jax.numpy as jnp
@@ -43,7 +44,7 @@ from simclr_pytorch_distributed_tpu.ops.augment import (
     AugmentConfig,
     two_crop_batch,
 )
-from simclr_pytorch_distributed_tpu.ops import pallas_loss
+from simclr_pytorch_distributed_tpu.ops import pallas_conv, pallas_loss
 from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
@@ -110,6 +111,46 @@ def make_augment_config(cfg: config_lib.SupConConfig, color_ops: bool = True) ->
     return AugmentConfig(size=cfg.size, mean=mean, std=std, color_ops=color_ops)
 
 
+def resolve_loss_impl_reasoned(
+    loss_impl: str, batch_size: int, n_devices: int, model_parallel: int = 1,
+    moco_queue: int = 0,
+) -> tuple:
+    """``(resolved_impl, reason)`` — the ``resolve_loss_impl`` ladder with
+    the WHY attached, so the driver's startup banner
+    (config.impl_resolution_banner) can name a silent degradation
+    (unsupported geometry, non-TPU backend) instead of leaving it
+    discoverable only by reading this function."""
+    if moco_queue and loss_impl == "auto":
+        return "dense", (
+            f"--moco_queue {moco_queue} extends the contrast side past the "
+            "fixed 2B geometry the fused/ring kernels tile"
+        )
+    if loss_impl != "auto":
+        return loss_impl, "explicit request"
+    if jax.default_backend() != "tpu":
+        return "dense", (
+            f"non-TPU backend ({jax.default_backend()}): the fused Pallas "
+            "kernel compiles on TPU only"
+        )
+    data_parallel = max(1, n_devices // max(1, model_parallel))
+    if data_parallel == 1:
+        if pallas_loss.supports(batch_size, 2):
+            return "fused", "TPU single-chip, geometry tiles (+6.6% e2e)"
+        return "dense", (
+            f"2B={2 * batch_size} does not tile the fused kernel's blocks "
+            "(ops/pallas_loss.supports)"
+        )
+    if pallas_loss.supports_sharded(batch_size, 2, data_parallel):
+        return "fused", (
+            f"TPU mesh (data={data_parallel}): shard_map-sharded fused "
+            "kernel, anchors stay sharded"
+        )
+    return "dense", (
+        f"2B={2 * batch_size} over data={data_parallel} does not tile the "
+        "sharded fused kernel (ops/pallas_loss.supports_sharded)"
+    )
+
+
 def resolve_loss_impl(
     loss_impl: str, batch_size: int, n_devices: int, model_parallel: int = 1,
     moco_queue: int = 0,
@@ -129,18 +170,124 @@ def resolve_loss_impl(
     (explicit fused/ring with a queue is rejected at parse,
     config.validate_recipe).
     """
-    if moco_queue and loss_impl == "auto":
-        return "dense"
-    if loss_impl != "auto":
-        return loss_impl
+    impl, _ = resolve_loss_impl_reasoned(
+        loss_impl, batch_size, n_devices, model_parallel, moco_queue
+    )
+    return impl
+
+
+def conv_fused_sites(
+    model: str, rows: int, size: int
+) -> List[str]:
+    """The encoder sites ``--conv_impl pallas`` would fuse at this
+    geometry: walks the model's stage structure against the
+    ops/pallas_conv ``supports_*`` gates. ``rows`` is the encoder's
+    view-major batch (``2*batch_size`` for the two-crop step). Bottleneck
+    models admit the stem only (the 1x1-3x3-1x1 chain is the recorded
+    open edge, docs/PERF.md round 15)."""
+    from simclr_pytorch_distributed_tpu.models.resnet import BasicBlock
+
+    ctor, _ = MODEL_DICT[model]
+    mod = ctor()
+    sites: List[str] = []
+    h = w = size
+    if pallas_conv.supports_stem(rows, h, w, 3, 64):
+        sites.append(f"stem 3->64@{h}x{w}")
+    if mod.block_cls is not BasicBlock:
+        return sites
+    widths = (64, 128, 256, 512)
+    stage_strides = (1, 2, 2, 2)
+    in_c = 64
+    for stage, (n_blocks, width, stage_stride) in enumerate(
+        zip(mod.stage_sizes, widths, stage_strides)
+    ):
+        for block in range(n_blocks):
+            stride = stage_stride if block == 0 else 1
+            if stride != 1:
+                # stride-2 conv with (1,1) padding: out = ceil(h/2) — the
+                # model's own gates see this exact shape at odd sizes
+                h = (h + 1) // 2
+                w = (w + 1) // 2
+            elif in_c == width and pallas_conv.supports_block(
+                rows, h, w, width, stride=stride, in_channels=in_c
+            ):
+                sites.append(f"layer{stage + 1}_block{block} {width}@{h}x{w}")
+            in_c = width
+    return sites
+
+
+def resolve_conv_impl(
+    conv_impl: str, model: str, batch_size: int, size: int,
+    n_devices: int, bf16: bool = False,
+) -> tuple:
+    """``(resolved_impl, reason)`` for ``--conv_impl`` — the
+    ``resolve_loss_impl`` ladder convention applied to the encoder's conv
+    path (ops/pallas_conv.py).
+
+    'auto' picks the fused Pallas stem/BasicBlock kernels only on a
+    single-device TPU mesh, fp32, at geometries the per-site
+    ``supports_*`` gates admit (the model applies them site by site; the
+    reason names the admitted sites). Explicit 'pallas' is honored on any
+    backend (interpret mode off-TPU — tests and the checkpoint
+    round-trip smoke, not throughput) but raises loudly where it could
+    only be a silent no-op (multi-device mesh, zero admitted sites) —
+    the placement ladder's honored-or-raise rule.
+    """
+    if conv_impl == "xla":
+        return "xla", "explicit request: bitwise-pinned XLA conv path"
+    rows = 2 * batch_size
+    if conv_impl == "pallas":
+        if bf16:
+            # parse-time validate_conv_impl rejects the CLI spelling; this
+            # guards programmatic callers (bench, tests) identically
+            raise ValueError(
+                "--conv_impl pallas requires fp32 compute (fused kernels "
+                "implement fp32 whole-batch BN) — drop --bf16 or use auto"
+            )
+        if n_devices > 1:
+            raise ValueError(
+                f"--conv_impl pallas requires a single-device mesh, got "
+                f"{n_devices} devices: the fused kernels compute whole-"
+                "batch BN statistics inside one program (per-device BN "
+                "groups / GSPMD partitioning of the pallas_call are the "
+                "recorded open edge, docs/PERF.md round 15)"
+            )
+        sites = conv_fused_sites(model, rows, size)
+        if not sites:
+            raise ValueError(
+                f"--conv_impl pallas admits no site for {model} at "
+                f"[{rows},{size},{size}] (fp32 identity-shortcut "
+                "BasicBlocks + stem only; see ops/pallas_conv.supports_*) "
+                "— use auto, which degrades to xla with a banner"
+            )
+        backend = jax.default_backend()
+        mode = (
+            "compiled" if backend == "tpu"
+            else f"INTERPRET mode on {backend} (correctness only, slow)"
+        )
+        return "pallas", (
+            f"explicit request, {mode}; fused sites: {', '.join(sites)}"
+        )
+    # auto
     if jax.default_backend() != "tpu":
-        return "dense"
-    data_parallel = max(1, n_devices // max(1, model_parallel))
-    if data_parallel == 1:
-        return "fused" if pallas_loss.supports(batch_size, 2) else "dense"
-    if pallas_loss.supports_sharded(batch_size, 2, data_parallel):
-        return "fused"
-    return "dense"
+        return "xla", (
+            f"non-TPU backend ({jax.default_backend()}): fused kernels "
+            "compile on TPU only"
+        )
+    if n_devices > 1:
+        return "xla", (
+            f"multi-device mesh ({n_devices}): fused kernels are "
+            "single-chip (whole-batch BN inside one program)"
+        )
+    if bf16:
+        return "xla", "--bf16: fused kernels are fp32-only"
+    sites = conv_fused_sites(model, rows, size)
+    if not sites:
+        return "xla", (
+            f"no admitted geometry for {model} at [{rows},{size},{size}] "
+            "(ops/pallas_conv.supports_*)"
+        )
+    return "pallas", f"TPU single-chip, fused sites: {', '.join(sites)}"
 
 
 def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1):
@@ -151,10 +298,25 @@ def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1
     # BN statistics are scoped to the data-parallel device slices, not the
     # global batch (models/norm.py grouped mode).
     data_parallel = max(1, n_devices // max(1, cfg.model_parallel))
+    # --conv_impl: the encoder's conv-block path (ops/pallas_conv.py).
+    # Resolved HERE, with the startup banner naming the resolution and the
+    # reason (the data_placement ladder convention) — a silent degradation
+    # must be discoverable from the log
+    conv_impl, conv_reason = resolve_conv_impl(
+        cfg.conv_impl, cfg.model, cfg.batch_size, cfg.size, n_devices,
+        bf16=cfg.bf16,
+    )
+    logging.info(
+        "%s",
+        config_lib.impl_resolution_banner(
+            "conv_impl", cfg.conv_impl, conv_impl, conv_reason
+        ),
+    )
     model = SupConResNet(
         model_name=cfg.model, head=cfg.head, feat_dim=cfg.feat_dim,
         dtype=dtype, sync_bn=cfg.syncBN, remat=cfg.remat,
         bn_local_groups=1 if cfg.syncBN else data_parallel,
+        conv_impl=conv_impl,
     )
     # --ngpu auto -> the mesh's data-parallel size; an explicit mismatch is
     # promoted from a log-only warning to a startup banner naming the
@@ -181,15 +343,22 @@ def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1
         model, tx, jax.random.key(cfg.seed),
         jnp.zeros((2, cfg.size, cfg.size, 3), jnp.float32),
     )
+    loss_impl, loss_reason = resolve_loss_impl_reasoned(
+        cfg.loss_impl, cfg.batch_size, n_devices, cfg.model_parallel,
+        moco_queue=cfg.moco_queue,
+    )
+    logging.info(
+        "%s",
+        config_lib.impl_resolution_banner(
+            "loss_impl", cfg.loss_impl, loss_impl, loss_reason
+        ),
+    )
     step_cfg = SupConStepConfig(
         method=cfg.method, temperature=cfg.temp,
         sec=cfg.sec, sec_wei=cfg.sec_wei, l2reg=cfg.l2reg, l2reg_wei=cfg.l2reg_wei,
         norm_momentum=cfg.norm_momentum, epochs=cfg.epochs,
         steps_per_epoch=steps_per_epoch, grad_div=float(grad_div),
-        loss_impl=resolve_loss_impl(
-            cfg.loss_impl, cfg.batch_size, n_devices, cfg.model_parallel,
-            moco_queue=cfg.moco_queue,
-        ),
+        loss_impl=loss_impl,
         health=cfg.health_freq > 0,
         health_freq=max(1, cfg.health_freq),
         online_probe=cfg.online_probe == "on",
@@ -591,8 +760,8 @@ def run(cfg: config_lib.SupConConfig) -> TrainState:
         obs.close(exit_code=exit_code_for(e))
         raise
     obs.staged()  # staging done: reset the watchdog deadline (utils/obs.py)
+    # build() emits the loss_impl/conv_impl resolution banners
     model, schedule, tx, state, step_cfg = build(cfg, steps_per_epoch, mesh.size)
-    logging.info("contrastive loss impl: %s", step_cfg.loss_impl)
     # --recipe: the SSL loss head + its TrainState slots (recipes/). Attach
     # BEFORE any resume restore so the abstract state carries the recipe
     # slots (the probe convention below); slot-free recipes leave the state

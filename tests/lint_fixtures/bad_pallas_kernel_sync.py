@@ -1,0 +1,29 @@
+"""Known-bad: a sync-forcing host op inside a Pallas kernel builder.
+
+Minimal reconstruction of the hazard the pallas-kernel region guards: a
+``np.asarray`` on a kernel ref would either fail the TPU lowering or
+silently constant-fold in interpret mode while the compiled path
+diverges. The kernel reaches ``pallas_call`` through the repo's real
+shape — an intermediate ``functools.partial`` assignment.
+"""
+
+import functools
+
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def _bad_kernel(x_ref, o_ref):
+    peek = np.asarray(x_ref[0])  # BAD: host materialization inside a kernel
+    o_ref[:] = x_ref[:] * peek[0]
+
+
+def _clean_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * 2.0
+
+
+def build(x, out_shape):
+    kernel = functools.partial(_bad_kernel)
+    bad = pl.pallas_call(kernel, out_shape=out_shape)(x)
+    clean = pl.pallas_call(_clean_kernel, out_shape=out_shape)(x)
+    return bad, clean

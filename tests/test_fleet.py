@@ -441,6 +441,24 @@ def test_ledger_record_schema_and_fingerprint_identity():
     assert rec["fingerprint"] != other["fingerprint"]
 
 
+def test_ledger_fingerprint_keys_on_conv_impl():
+    """A --conv_impl pallas bench run must never land in an xla-path
+    fingerprint group (the regression scan would compare across kernel
+    implementations); records predating the flag — and the explicit
+    default 'xla' — keep their committed fingerprints."""
+    pl = _load("perf_ledger")
+    base = _bench_record()
+    pre_flag = pl.record_from_bench(base, "abc", 1722.0)
+    explicit_xla = _bench_record()
+    explicit_xla["detail"]["conv_impl"] = "xla"
+    xla_rec = pl.record_from_bench(explicit_xla, "abc", 1722.0)
+    pallas = _bench_record()
+    pallas["detail"]["conv_impl"] = "pallas"
+    pallas_rec = pl.record_from_bench(pallas, "abc", 1722.0)
+    assert pre_flag["fingerprint"] == xla_rec["fingerprint"]
+    assert pallas_rec["fingerprint"] != xla_rec["fingerprint"]
+
+
 def _ledger(values, suspects=None, shares=None):
     pl = _load("perf_ledger")
     suspects = suspects or [False] * len(values)

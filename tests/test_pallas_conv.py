@@ -1,0 +1,513 @@
+"""Fused Pallas conv+BN+ReLU kernels vs the Flax oracle (interpret mode).
+
+The fused stem (``fused_conv_bn_relu``) and residual-block
+(``fused_basic_block``) kernels (ops/pallas_conv.py) must match the
+bitwise-pinned Flax path — ``nn.Conv`` + ``CrossReplicaBatchNorm`` in
+whole-batch train mode — in value, in every parameter/input gradient, and
+in the batch statistics that feed the running-stat update, across every
+geometry class ``supports_*`` admits. Unsupported geometries must fall
+back to the XLA path, eval mode must stay bitwise-XLA, and the param tree
+must be impl-independent (a ``--conv_impl pallas`` checkpoint restores
+under ``--conv_impl xla`` — proven through the real driver below).
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from simclr_pytorch_distributed_tpu import config as config_lib
+from simclr_pytorch_distributed_tpu.models import SupConResNet
+from simclr_pytorch_distributed_tpu.models.norm import (
+    CrossReplicaBatchNorm,
+    FusedTrainBN,
+    running_stats_update,
+)
+from simclr_pytorch_distributed_tpu.models.resnet import BasicBlock
+from simclr_pytorch_distributed_tpu.ops import pallas_conv
+
+pytestmark = pytest.mark.kernel
+
+# Interpret-mode kernels accumulate in a different order than XLA's conv
+# emitter; fp32 accumulation noise at these magnitudes measured ~1e-6
+# relative (values) / ~3e-5 absolute on O(100) gradient scales. Pinned
+# with ~30x margin.
+VAL_RTOL, VAL_ATOL = 3e-5, 3e-5
+GRAD_RTOL, GRAD_ATOL = 1e-4, 1e-3
+
+
+def _flax_stem(x, k, g, b):
+    """conv3x3/s1 + whole-batch train BN + ReLU via the production
+    modules, returning (out, mutated batch_stats)."""
+
+    class Stem(nn.Module):
+        @nn.compact
+        def __call__(self, xin):
+            y = nn.Conv(
+                k.shape[3], (3, 3), strides=(1, 1), use_bias=False,
+                padding=((1, 1), (1, 1)), param_dtype=jnp.float32,
+                name="conv",
+            )(xin)
+            return nn.relu(
+                CrossReplicaBatchNorm(use_running_average=False, name="bn")(y)
+            )
+
+    mod = Stem()
+    variables = {
+        "params": {
+            "conv": {"kernel": k},
+            "bn": {"scale": g, "bias": b},
+        },
+        "batch_stats": {
+            "bn": {
+                "mean": jnp.zeros((k.shape[3],), jnp.float32),
+                "var": jnp.ones((k.shape[3],), jnp.float32),
+            }
+        },
+    }
+    return mod.apply(variables, x, mutable=["batch_stats"])
+
+
+def _flax_block(x, k1, g1, b1, k2, g2, b2):
+    """The production BasicBlock (identity shortcut) in train mode."""
+    mod = BasicBlock(planes=k1.shape[3])
+    variables = {
+        "params": {
+            "Conv_0": {"kernel": k1},
+            "bn1": {"scale": g1, "bias": b1},
+            "Conv_1": {"kernel": k2},
+            "bn2": {"scale": g2, "bias": b2},
+        },
+        "batch_stats": {
+            "bn1": {
+                "mean": jnp.zeros((k1.shape[3],), jnp.float32),
+                "var": jnp.ones((k1.shape[3],), jnp.float32),
+            },
+            "bn2": {
+                "mean": jnp.zeros((k2.shape[3],), jnp.float32),
+                "var": jnp.ones((k2.shape[3],), jnp.float32),
+            },
+        },
+    }
+    return mod.apply(variables, x, True, mutable=["batch_stats"])
+
+
+def _block_args(rng, n, h, w, c):
+    def arr(*shape, scale=1.0, shift=0.0):
+        return jnp.asarray(
+            rng.standard_normal(shape).astype(np.float32) * scale + shift
+        )
+
+    return (
+        arr(n, h, w, c),
+        arr(3, 3, c, c, scale=0.2), arr(c, shift=1.0), arr(c, scale=0.1),
+        arr(3, 3, c, c, scale=0.2), arr(c, shift=1.0), arr(c, scale=0.1),
+    )
+
+
+# one geometry per admitted class: square stage-1-like, non-square (h != w),
+# tall-channel, and a batch the tile picker must split unevenly (bn=4)
+BLOCK_GEOMETRIES = [(16, 8, 8, 8), (8, 10, 6, 16), (16, 4, 4, 24), (12, 8, 8, 8)]
+
+
+@pytest.mark.parametrize("n,h,w,c", BLOCK_GEOMETRIES)
+def test_fused_block_forward_matches_flax(rng, n, h, w, c):
+    x, k1, g1, b1, k2, g2, b2 = _block_args(rng, n, h, w, c)
+    assert pallas_conv.supports_block(n, h, w, c)
+    out_f, m1, v1, m2, v2 = pallas_conv.fused_basic_block(
+        x, k1, g1, b1, k2, g2, b2, interpret=True
+    )
+    out_r, mut = _flax_block(x, k1, g1, b1, k2, g2, b2)
+    np.testing.assert_allclose(
+        np.asarray(out_f), np.asarray(out_r), rtol=VAL_RTOL, atol=VAL_ATOL
+    )
+    # batch moments -> the same running-stat update as models/norm.py
+    count = n * h * w
+    for bn_name, (m, v) in (("bn1", (m1, v1)), ("bn2", (m2, v2))):
+        ra_m, ra_v = running_stats_update(
+            jnp.zeros((c,)), jnp.ones((c,)), m, v, count, 0.1
+        )
+        np.testing.assert_allclose(
+            np.asarray(ra_m),
+            np.asarray(mut["batch_stats"][bn_name]["mean"]),
+            rtol=VAL_RTOL, atol=VAL_ATOL,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ra_v),
+            np.asarray(mut["batch_stats"][bn_name]["var"]),
+            rtol=VAL_RTOL, atol=VAL_ATOL,
+        )
+
+
+@pytest.mark.parametrize("n,h,w,c", BLOCK_GEOMETRIES[:2])
+def test_fused_block_gradients_match_flax(rng, n, h, w, c):
+    args = _block_args(rng, n, h, w, c)
+
+    def loss_fused(*a):
+        out = pallas_conv.fused_basic_block(*a, interpret=True)[0]
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_flax(*a):
+        out, _ = _flax_block(*a)
+        return jnp.sum(out * jnp.cos(out))
+
+    gf = jax.grad(loss_fused, argnums=tuple(range(7)))(*args)
+    gr = jax.grad(loss_flax, argnums=tuple(range(7)))(*args)
+    names = ("dx", "dk1", "dg1", "db1", "dk2", "dg2", "db2")
+    for name, a, b in zip(names, gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=GRAD_RTOL, atol=GRAD_ATOL,
+            err_msg=name,
+        )
+
+
+def test_fused_stem_matches_flax_value_and_grads(rng):
+    n, h, w, cin, cout = 8, 8, 8, 3, 16
+    x = jnp.asarray(rng.standard_normal((n, h, w, cin)).astype(np.float32))
+    k = jnp.asarray(
+        rng.standard_normal((3, 3, cin, cout)).astype(np.float32) * 0.2
+    )
+    g = jnp.asarray(rng.standard_normal((cout,)).astype(np.float32) + 1.0)
+    b = jnp.asarray(rng.standard_normal((cout,)).astype(np.float32) * 0.1)
+    assert pallas_conv.supports_stem(n, h, w, cin, cout)
+
+    out_f, m, v = pallas_conv.fused_conv_bn_relu(x, k, g, b, interpret=True)
+    out_r, mut = _flax_stem(x, k, g, b)
+    np.testing.assert_allclose(
+        np.asarray(out_f), np.asarray(out_r), rtol=VAL_RTOL, atol=VAL_ATOL
+    )
+    ra_m, ra_v = running_stats_update(
+        jnp.zeros((cout,)), jnp.ones((cout,)), m, v, n * h * w, 0.1
+    )
+    np.testing.assert_allclose(
+        np.asarray(ra_m), np.asarray(mut["batch_stats"]["bn"]["mean"]),
+        rtol=VAL_RTOL, atol=VAL_ATOL,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ra_v), np.asarray(mut["batch_stats"]["bn"]["var"]),
+        rtol=VAL_RTOL, atol=VAL_ATOL,
+    )
+
+    def loss_fused(*a):
+        out, _, _ = pallas_conv.fused_conv_bn_relu(*a, interpret=True)
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_flax(*a):
+        out, _ = _flax_stem(*a)
+        return jnp.sum(out * jnp.cos(out))
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, k, g, b)
+    gr = jax.grad(loss_flax, argnums=(0, 1, 2, 3))(x, k, g, b)
+    for name, a, bb in zip(("dx", "dk", "dg", "db"), gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bb), rtol=GRAD_RTOL, atol=GRAD_ATOL,
+            err_msg=name,
+        )
+
+
+def test_supports_gates():
+    # identity shortcut only
+    assert not pallas_conv.supports_block(16, 8, 8, 8, stride=2)
+    assert not pallas_conv.supports_block(16, 8, 8, 16, in_channels=8)
+    # degenerate spatial dims (3x3 window needs h,w >= 3)
+    assert not pallas_conv.supports_block(16, 2, 2, 8)
+    # VMEM blowout: stage-4-like 512 channels (weights + dW accumulators
+    # alone exceed the budget)
+    assert not pallas_conv.supports_block(8, 16, 16, 512)
+    # admitted classes
+    assert pallas_conv.supports_block(512, 32, 32, 64)   # rn18 stage 1 @ B=256
+    assert pallas_conv.supports_block(512, 16, 16, 128)  # rn18 stage 2 @ B=256
+    assert pallas_conv.supports_stem(512, 32, 32, 3, 64)
+
+
+def test_direct_call_rejects_inadmissible_geometry():
+    with pytest.raises(ValueError, match="supports_block"):
+        # stride/in_channels admissible but VMEM-inadmissible channels
+        pallas_conv.fused_basic_block(
+            jnp.zeros((8, 16, 16, 512)), jnp.zeros((3, 3, 512, 512)),
+            jnp.ones((512,)), jnp.zeros((512,)),
+            jnp.zeros((3, 3, 512, 512)), jnp.ones((512,)),
+            jnp.zeros((512,)), interpret=True,
+        )
+
+
+# ---------------------------------------------------------------- module
+
+
+def _models(**kw):
+    mx = SupConResNet(model_name="resnet10", head="mlp", feat_dim=16, **kw)
+    mp = SupConResNet(
+        model_name="resnet10", head="mlp", feat_dim=16, conv_impl="pallas",
+        **kw,
+    )
+    return mx, mp
+
+
+def test_encoder_param_trees_impl_independent():
+    """Init under both impls yields IDENTICAL trees (structure and values):
+    the checkpoint contract that lets --conv_impl swap across restores."""
+    mx, mp = _models()
+    vx = mx.init(jax.random.key(0), jnp.zeros((2, 8, 8, 3)), train=True)
+    vp = mp.init(jax.random.key(0), jnp.zeros((2, 8, 8, 3)), train=True)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        vx, vp,
+    )
+
+
+def test_encoder_pallas_matches_xla_fwd_grads_stats(rng):
+    mx, mp = _models()
+    x = jnp.asarray(rng.standard_normal((8, 8, 8, 3)).astype(np.float32))
+    v = mx.init(jax.random.key(0), jnp.zeros((2, 8, 8, 3)), train=True)
+
+    def run(m):
+        return m.apply(v, x, train=True, mutable=["batch_stats"])
+
+    ox, mutx = run(mx)
+    op, mutp = run(mp)
+    np.testing.assert_allclose(
+        np.asarray(ox), np.asarray(op), rtol=1e-4, atol=1e-4
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+        ),
+        mutx["batch_stats"], mutp["batch_stats"],
+    )
+
+    def loss(params, m):
+        out, _ = m.apply(
+            {"params": params, "batch_stats": v["batch_stats"]},
+            x, train=True, mutable=["batch_stats"],
+        )
+        return jnp.sum(out * jnp.cos(out))
+
+    gx = jax.grad(loss)(v["params"], mx)
+    gp = jax.grad(loss)(v["params"], mp)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3
+        ),
+        gx, gp,
+    )
+
+
+def test_encoder_eval_mode_stays_bitwise_xla(rng):
+    """train=False never touches the fused path: bitwise-identical output
+    (the validation/probe encode path keeps its pinned numerics)."""
+    mx, mp = _models()
+    x = jnp.asarray(rng.standard_normal((8, 8, 8, 3)).astype(np.float32))
+    v = mx.init(jax.random.key(0), jnp.zeros((2, 8, 8, 3)), train=True)
+    ex = mx.apply(v, x, train=False)
+    ep = mp.apply(v, x, train=False)
+    np.testing.assert_array_equal(np.asarray(ex), np.asarray(ep))
+
+
+def test_unsupported_sites_fall_back_without_touching_kernels(
+    rng, monkeypatch
+):
+    """bf16 compute admits no fused site: the pallas-impl model must never
+    call into ops/pallas_conv (proven by poisoning the kernels), and eval
+    mode likewise."""
+
+    def boom(*a, **k):
+        raise AssertionError("fused kernel called on an unsupported path")
+
+    monkeypatch.setattr(pallas_conv, "fused_basic_block", boom)
+    monkeypatch.setattr(pallas_conv, "fused_conv_bn_relu", boom)
+    x = jnp.asarray(rng.standard_normal((8, 8, 8, 3)).astype(np.float32))
+    m_bf16 = SupConResNet(
+        model_name="resnet10", head="mlp", feat_dim=16,
+        conv_impl="pallas", dtype=jnp.bfloat16,
+    )
+    v = m_bf16.init(jax.random.key(0), jnp.zeros((2, 8, 8, 3)), train=True)
+    m_bf16.apply(v, x, train=True, mutable=["batch_stats"])  # xla fallback
+    mx, mp = _models()
+    v = mx.init(jax.random.key(0), jnp.zeros((2, 8, 8, 3)), train=True)
+    mp.apply(v, x, train=False)  # eval: fused path must stay untouched
+
+
+# ------------------------------------------------------------- resolution
+
+
+def test_resolve_conv_impl_ladder(monkeypatch):
+    from simclr_pytorch_distributed_tpu.train import supcon
+
+    # explicit xla: honored anywhere
+    impl, reason = supcon.resolve_conv_impl("xla", "resnet18", 256, 32, 1)
+    assert impl == "xla" and "explicit" in reason
+    # auto on CPU: degrades with the backend named
+    impl, reason = supcon.resolve_conv_impl("auto", "resnet18", 256, 32, 1)
+    assert impl == "xla" and "non-TPU" in reason
+    # auto on TPU single chip: pallas, reason names the fused sites
+    monkeypatch.setattr(supcon.jax, "default_backend", lambda: "tpu")
+    impl, reason = supcon.resolve_conv_impl("auto", "resnet18", 256, 32, 1)
+    assert impl == "pallas"
+    assert "layer1_block0" in reason and "stem" in reason
+    # auto multi-device: xla with the mesh named
+    impl, reason = supcon.resolve_conv_impl("auto", "resnet18", 256, 32, 8)
+    assert impl == "xla" and "multi-device" in reason
+    # auto + bf16: xla
+    impl, reason = supcon.resolve_conv_impl(
+        "auto", "resnet18", 256, 32, 1, bf16=True
+    )
+    assert impl == "xla" and "bf16" in reason
+    # explicit pallas: honored-or-raise
+    with pytest.raises(ValueError, match="single-device"):
+        supcon.resolve_conv_impl("pallas", "resnet18", 256, 32, 8)
+    with pytest.raises(ValueError, match="fp32"):
+        supcon.resolve_conv_impl("pallas", "resnet18", 256, 32, 1, bf16=True)
+
+
+def test_conv_fused_sites_geometry_walk():
+    from simclr_pytorch_distributed_tpu.train import supcon
+
+    sites = supcon.conv_fused_sites("resnet18", 512, 32)
+    # stage 1 fully fused, stage-2 non-first block at 16x16; stride-2
+    # stage-leading blocks and the VMEM-inadmissible late stages excluded
+    assert "stem 3->64@32x32" in sites
+    assert "layer1_block0 64@32x32" in sites
+    assert "layer1_block1 64@32x32" in sites
+    assert "layer2_block1 128@16x16" in sites
+    assert not any(s.startswith("layer2_block0") for s in sites)
+    assert not any(s.startswith("layer4") for s in sites)
+    # bottleneck models: stem only (the recorded open edge)
+    assert supcon.conv_fused_sites("resnet50", 512, 32) == ["stem 3->64@32x32"]
+    # odd sizes: the walker halves like the stride-2 conv itself does
+    # (ceil(h/2) under (1,1) padding), so the banner/raise geometry can
+    # never diverge from the model's own per-site gates
+    odd = supcon.conv_fused_sites("resnet18", 32, 33)
+    assert "layer2_block1 128@17x17" in odd
+
+
+def test_resolve_loss_impl_reasoned_names_degradations(monkeypatch):
+    from simclr_pytorch_distributed_tpu.train import supcon
+
+    impl, reason = supcon.resolve_loss_impl_reasoned("auto", 256, 1)
+    assert impl == "dense" and "non-TPU" in reason
+    impl, reason = supcon.resolve_loss_impl_reasoned("dense", 256, 1)
+    assert impl == "dense" and reason == "explicit request"
+    impl, reason = supcon.resolve_loss_impl_reasoned(
+        "auto", 256, 1, moco_queue=512
+    )
+    assert impl == "dense" and "moco_queue" in reason
+    monkeypatch.setattr(supcon.jax, "default_backend", lambda: "tpu")
+    impl, reason = supcon.resolve_loss_impl_reasoned("auto", 256, 1)
+    assert impl == "fused" and "single-chip" in reason
+    impl, reason = supcon.resolve_loss_impl_reasoned("auto", 3, 1)
+    assert impl == "dense" and "tile" in reason
+
+
+def test_impl_resolution_banner_format():
+    line = config_lib.impl_resolution_banner(
+        "conv_impl", "auto", "xla", "non-TPU backend (cpu)"
+    )
+    assert line == (
+        "[conv_impl] requested 'auto' -> resolved 'xla': non-TPU backend (cpu)"
+    )
+    same = config_lib.impl_resolution_banner(
+        "conv_impl", "xla", "xla", "explicit request"
+    )
+    assert same == "[conv_impl] 'xla': explicit request"
+
+
+def test_build_logs_resolution_banners(tmp_path, caplog):
+    import logging
+
+    from simclr_pytorch_distributed_tpu.train.supcon import build
+
+    cfg = config_lib.SupConConfig(
+        model="resnet10", dataset="synthetic", batch_size=8, epochs=1,
+        size=8, workdir=str(tmp_path),
+    )
+    cfg = config_lib.finalize_supcon(cfg, make_dirs=False)
+    with caplog.at_level(logging.INFO):
+        build(cfg, steps_per_epoch=4, n_devices=1)
+    text = caplog.text
+    assert "[conv_impl]" in text and "[loss_impl]" in text
+
+
+def test_validate_conv_impl_rejects_pallas_bf16():
+    with pytest.raises(ValueError, match="conv_impl pallas"):
+        config_lib.validate_conv_impl(
+            config_lib.SupConConfig(conv_impl="pallas", bf16=True)
+        )
+    # auto + bf16 degrades instead (no raise)
+    config_lib.validate_conv_impl(
+        config_lib.SupConConfig(conv_impl="auto", bf16=True)
+    )
+
+
+def test_parser_accepts_conv_impl():
+    p = config_lib.supcon_parser()
+    ns = p.parse_args(["--conv_impl", "pallas"])
+    assert ns.conv_impl == "pallas"
+    assert p.parse_args([]).conv_impl == "auto"
+
+
+def test_fused_train_bn_running_update_matches_norm():
+    """FusedTrainBN's second call applies EXACTLY the norm.py running
+    update (single-sourced via running_stats_update)."""
+    bn = FusedTrainBN(4)
+    v = bn.init(jax.random.key(0))
+    m = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    var = jnp.asarray([0.5, 1.5, 2.5, 3.5])
+    (scale, bias), mut = bn.apply(v, m, var, 100, mutable=["batch_stats"])
+    exp_m, exp_v = running_stats_update(
+        jnp.zeros((4,)), jnp.ones((4,)), m, var, 100, 0.1
+    )
+    np.testing.assert_allclose(np.asarray(mut["batch_stats"]["mean"]), exp_m)
+    np.testing.assert_allclose(np.asarray(mut["batch_stats"]["var"]), exp_v)
+    np.testing.assert_array_equal(np.asarray(scale), np.ones(4))
+    np.testing.assert_array_equal(np.asarray(bias), np.zeros(4))
+
+
+# ----------------------------------------------------- real-driver smoke
+
+
+def test_driver_pallas_checkpoint_restores_under_xla(tmp_path, monkeypatch):
+    """2-epoch --conv_impl pallas pretrain through the REAL driver, then a
+    resume under --conv_impl xla: the param tree is impl-independent, so
+    the restore continues the trajectory (and the banners name both
+    resolutions)."""
+    from simclr_pytorch_distributed_tpu.data import cifar as cifar_lib
+    from simclr_pytorch_distributed_tpu.parallel import mesh as mesh_lib
+    from simclr_pytorch_distributed_tpu.train import supcon as supcon_driver
+
+    orig = cifar_lib.synthetic_dataset
+
+    def small(n=2048, num_classes=10, seed=0, size=32):
+        return orig(n=104, num_classes=num_classes, seed=seed, size=8)
+
+    monkeypatch.setattr(cifar_lib, "synthetic_dataset", small)
+
+    def limited_create_mesh(devices=None, **kw):
+        if devices is None:
+            devices = jax.devices()[:1]
+        return mesh_lib.create_mesh(devices=devices, **kw)
+
+    monkeypatch.setattr(supcon_driver, "create_mesh", limited_create_mesh)
+
+    def cfg_for(conv_impl, epochs, resume=""):
+        cfg = config_lib.SupConConfig(
+            model="resnet10", dataset="synthetic", batch_size=32, epochs=epochs,
+            learning_rate=0.05, temp=0.5, size=8, workdir=str(tmp_path),
+            save_freq=1, print_freq=2, seed=0, method="SimCLR",
+            conv_impl=conv_impl, resume=resume, health_freq=0,
+        )
+        return config_lib.finalize_supcon(cfg)
+
+    cfg1 = cfg_for("pallas", epochs=2)
+    state1 = supcon_driver.run(cfg1)
+    steps1 = int(state1.step)
+    assert steps1 > 0
+    # restore the pallas-written checkpoint under the xla impl
+    cfg2 = cfg_for("xla", epochs=3, resume=f"{cfg1.save_folder}/last")
+    state2 = supcon_driver.run(cfg2)
+    assert int(state2.step) == steps1 // 2 * 3

@@ -543,6 +543,144 @@ def test_window_ab_smoke_window_arm_amortizes_per_step_transfer(tmp_path):
     assert artifact["equivalence"]["equivalence_ok"]
 
 
+# ------------------------------------------------------- convblock_ab
+
+
+def test_convblock_ab_build_output_schema():
+    """The committed docs/evidence/convblock_ab_r15.json schema, pinned
+    without running the measurement (the window_ab pattern)."""
+    convblock_ab = _load("convblock_ab")
+    rounds = [
+        {"xla": [120.0, 118.0], "pallas": [65.0, 64.0]},
+        {"xla": [119.0, 121.0], "pallas": [66.0, 63.0]},
+    ]
+    parity = {
+        "parity_ok": True, "value_ok": True, "grads_ok": True,
+        "stats_ok": True, "max_abs_diffs": {"out": 1e-6},
+        "tolerances": {"value_atol": 3e-5, "grad_rtol": 1e-4,
+                       "grad_atol": 1e-3},
+    }
+    geometry = {"batch": 32, "h": 16, "w": 16, "channels": 16}
+    out = convblock_ab.build_output("cpu", 5.0, geometry, 12, rounds, parity)
+    assert out["schema"] == convblock_ab.SCHEMA
+    assert out["metric"] == "convblock_ab_ms_per_step"
+    assert out["runs"] == rounds and out["parity"] == parity
+    assert out["geometry"] == geometry
+    # traversal counts are the kernel's own constants, not free parameters
+    from simclr_pytorch_distributed_tpu.ops import pallas_conv
+
+    assert out["traversals"]["pallas"] == (
+        pallas_conv.FWD_HBM_TRAVERSALS_BLOCK
+        + pallas_conv.BWD_HBM_TRAVERSALS_BLOCK
+    )
+    assert out["traversals"]["xla"] == (
+        pallas_conv.FWD_HBM_TRAVERSALS_XLA + pallas_conv.BWD_HBM_TRAVERSALS_XLA
+    )
+    s = out["summary"]
+    assert s["xla_ms_per_step"] == 119.5  # median of the 4 xla arms
+    assert s["pallas_ms_per_step"] == 64.5
+    assert s["traversal_removed_ms_per_step"] == 55.0
+    assert s["expected_removed_ms_per_step"] == 5.0 * (
+        out["traversals"]["xla"] - out["traversals"]["pallas"]
+    )
+    assert "ABBA" in out["arm_order"]
+    # the committed artifact carries this exact key set and passed parity
+    with open(os.path.join(
+        os.path.dirname(SCRIPTS), "docs", "evidence", "convblock_ab_r15.json"
+    )) as f:
+        committed = json.load(f)
+    assert set(out) == set(committed)
+    assert committed["parity"]["parity_ok"]
+    assert committed["summary"]["pallas_ms_per_step"] < \
+        committed["summary"]["xla_ms_per_step"]
+
+
+def test_convblock_ab_build_output_tolerates_broken_parity():
+    """A broken-parity run carries no timed rounds but must still write
+    the artifact (the ratchet gate carries the structured diffs): empty
+    records produce None timing summaries, never a raise."""
+    convblock_ab = _load("convblock_ab")
+    parity = {
+        "parity_ok": False, "value_ok": False, "grads_ok": True,
+        "stats_ok": True, "max_abs_diffs": {"out": 0.5},
+        "tolerances": {"value_atol": 3e-5, "grad_rtol": 1e-4,
+                       "grad_atol": 1e-3},
+    }
+    out = convblock_ab.build_output(
+        "cpu", 5.0, {"batch": 16, "h": 8, "w": 8, "channels": 8}, 4,
+        [], parity,
+    )
+    s = out["summary"]
+    assert s["xla_ms_per_step"] is None
+    assert s["pallas_ms_per_step"] is None
+    assert s["traversal_removed_ms_per_step"] is None
+    assert s["speedup"] is None
+    # and the gate fails it on the parity verdict, everywhere
+    ratchet = _load("ratchet")
+    rec = ratchet.convblock_gate_record(out)
+    assert not rec["ok"] and "diverges" in rec["error"]
+
+
+@pytest.mark.kernel
+def test_convblock_ab_smoke_parity_and_traversal_removal(tmp_path):
+    """Tier-1 guard on the committed-artifact path: the real script
+    end-to-end on the tiny config — interpret-mode kernel parity gating
+    the artifact, both timed arms, the ABBA loop, and the JSON artifact.
+    Under the injected per-traversal delay the pallas arm pays ~half the
+    traversals, so most of the modeled delta must materialize."""
+    convblock_ab = _load("convblock_ab")
+    out_path = tmp_path / "convblock_ab.json"
+    out = convblock_ab.main([
+        "--smoke", "--rounds", "1", "--steps", "2",
+        "--hbm_delay_ms", "15", "--json", str(out_path),
+    ])
+    assert out["parity"]["parity_ok"]
+    s = out["summary"]
+    assert s["pallas_ms_per_step"] < s["xla_ms_per_step"]
+    # expected removal = delay * (21 - 11) = 150 ms at these settings;
+    # require a third (generous vs 1-core contention noise)
+    assert s["traversal_removed_ms_per_step"] > \
+        s["expected_removed_ms_per_step"] / 3
+    artifact = json.loads(out_path.read_text())
+    assert artifact["schema"] == convblock_ab.SCHEMA
+    assert artifact["parity"]["parity_ok"]
+
+
+def test_ratchet_convblock_gate_decision():
+    """The fused conv-block gate rides the default config list: kernel
+    parity binds on EVERY device, the CPU-calibrated traversal-delay
+    timing claim pass-skips off-CPU with the reason on record."""
+    ratchet = _load("ratchet")
+    assert "convblock" in ratchet.CONFIGS
+    assert ratchet.CONFIGS["convblock"]["kind"] == "convblock_ab"
+
+    def art(device="cpu", xla=120.0, pallas=65.0, parity_ok=True):
+        return {
+            "summary": {"xla_ms_per_step": xla,
+                        "pallas_ms_per_step": pallas},
+            "parity": {"parity_ok": parity_ok, "value_ok": parity_ok,
+                       "grads_ok": parity_ok, "stats_ok": parity_ok,
+                       "max_abs_diffs": {"out": 1e-6}},
+            "traversals": {"xla": 21, "pallas": 11},
+            "device": device,
+        }
+
+    r = ratchet.convblock_gate_record(art())
+    assert r["ok"] and "skipped" not in r
+    assert r["metric"] == "ratchet_convblock_ab_parity"
+    # broken parity fails EVERYWHERE, even where timing pass-skips
+    r = ratchet.convblock_gate_record(art(device="TPU v4", parity_ok=False))
+    assert not r["ok"] and "diverges" in r["error"]
+    # an accelerator: parity enforced, CPU-calibrated timing skipped
+    r = ratchet.convblock_gate_record(
+        art(device="TPU v4", xla=64.9, pallas=65.2)
+    )
+    assert r["ok"] and "calibrated" in r["skipped"]
+    # on CPU the timing claim binds
+    r = ratchet.convblock_gate_record(art(xla=65.0, pallas=65.0))
+    assert not r["ok"] and "not faster" in r["error"]
+
+
 # ------------------------------------------------------- ratchet bench gate
 
 
