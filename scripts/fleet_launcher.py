@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""One supervisable command that owns a REAL multi-process gloo fleet —
+the straggler scenario's victim (scripts/supervisor_matrix.py).
+
+The supervisor babysits exactly ONE child process, but the straggler story
+is inherently multi-process: the skew signal comes from the widened
+failure-code allgather (utils/telemetry.py), which only exists when real
+processes rendezvous over gloo. This launcher is the bridge — the
+single-host stand-in for the scheduler-level fleet launcher a real pod has:
+
+- spawns ``--nproc`` ``tests/multiprocess_child.py`` driver-mode workers
+  (full pretrain: epoch loops, collective saves, preempt machinery) on a
+  freshly picked coordinator port (a relaunch must not fight TIME_WAIT for
+  the previous rendezvous port);
+- exposes process 0's ``/metrics`` sidecar on ``--metrics_port``
+  (``CHILD_METRICS_PORT``), so the supervisor scrapes the REAL fleet skew
+  gauges — ``train_boundary_skew_seconds`` / ``train_boundary_straggler``
+  / ``train_process_count`` from the gloo allgather, not a simulation;
+- arms the existing ``FLEET_STRAGGLER_MS`` hook (one process delays every
+  boundary allgather) behind a one-shot ``--straggler_marker``, written at
+  launch while arming — the supervisor's RELAUNCH of this same command
+  runs clean, the rebalanced-away shape;
+- RELAYS SIGTERM to the workers: the supervisor's graceful mitigation
+  preempt reaches every process's preemption machinery, the fleet takes
+  the collective preempt decision at a flush boundary, emergency-saves,
+  and every worker exits 75 — which this launcher then exits with, so the
+  supervisor sees the clean preempt its contract promises;
+- accepts the supervisor's appended ``--resume <run_dir>`` and forwards it
+  to every worker;
+- writes ``<workdir>/fleet_result.json`` on a completed run: per-process
+  final step/digest (the bit-identity evidence input) plus the
+  ``FLEET_SHARE_HINT`` it was launched under — proof the rebalance hint
+  actually carried into the relaunched fleet's environment.
+
+Exit code: 75 when any worker was preempted (collective preempt means all
+of them were), 0 when all completed, else the first failure's code
+(negative signal deaths shell-normalized to 128+N).
+"""
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(REPO, "tests", "multiprocess_child.py")
+
+_terminate = {"flag": False}
+
+
+def _handle_term(signum, frame):  # noqa: ARG001 — handler signature
+    _terminate["flag"] = True
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("supervised gloo fleet launcher")
+    p.add_argument("--workdir", required=True)
+    p.add_argument("--nproc", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--resume", default="",
+                   help="forwarded to every worker (the supervisor appends "
+                        "this on relaunches; argparse last-wins)")
+    p.add_argument("--metrics_port", type=int, default=0,
+                   help="process 0's /metrics sidecar (the supervisor's "
+                        "scrape target)")
+    p.add_argument("--straggler_ms", type=float, default=0.0,
+                   help="FLEET_STRAGGLER_MS injection: delay this process's "
+                        "arrival at every boundary allgather")
+    p.add_argument("--straggler_pid", type=int, default=1,
+                   help="which process straggles")
+    p.add_argument("--straggler_marker", default="",
+                   help="one-shot gate: injection arms only while this "
+                        "file is absent (written at launch when arming), "
+                        "so the supervisor's relaunch runs clean")
+    p.add_argument("--result_json", default="",
+                   help="default: <workdir>/fleet_result.json")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    os.makedirs(args.workdir, exist_ok=True)
+    result_json = args.result_json or os.path.join(
+        args.workdir, "fleet_result.json"
+    )
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # workers build their own 1-device topology; the supervisor-managed
+    # device-count flag (topology_env) is a per-worker concern a real
+    # scheduler realizes — stripping it here mirrors tests/_child_env
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f
+    )
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+    )
+    env["CHILD_LOCAL_DEVICES"] = "1"
+    env["CHILD_GUARDED"] = "1"
+
+    armed = args.straggler_ms > 0 and not (
+        args.straggler_marker and os.path.exists(args.straggler_marker)
+    )
+    if armed:
+        env["FLEET_STRAGGLER_MS"] = str(args.straggler_ms)
+        env["FLEET_STRAGGLER_PID"] = str(args.straggler_pid)
+        if args.straggler_marker:
+            with open(args.straggler_marker, "w") as f:
+                f.write(f"straggler {args.straggler_ms}ms")
+        print(
+            f"FLEET straggler armed: p{args.straggler_pid} "
+            f"+{args.straggler_ms}ms/boundary",
+            flush=True,
+        )
+    else:
+        env.pop("FLEET_STRAGGLER_MS", None)
+
+    share_hint = env.get("FLEET_SHARE_HINT", "")
+    if share_hint:
+        # the rebalance hint the supervisor carried into this relaunch
+        # (launch.share_env): on a real fleet the scheduler would route
+        # fewer examples to the named host; recorded here as evidence
+        print(f"FLEET share hint: {share_hint}", flush=True)
+
+    port = _free_port()
+    procs, logs = [], []
+    for i in range(args.nproc):
+        child_env = dict(env)
+        if i == 0 and args.metrics_port:
+            child_env["CHILD_METRICS_PORT"] = str(args.metrics_port)
+        log_path = os.path.join(args.workdir, f"fleet_p{i}.log")
+        logs.append(log_path)
+        argv_i = [
+            sys.executable, CHILD, str(i), str(args.nproc), str(port),
+            "driver", args.workdir, str(args.epochs),
+        ]
+        if args.resume:
+            argv_i.append(args.resume)
+        procs.append(
+            subprocess.Popen(
+                argv_i, env=child_env, cwd=REPO,
+                stdout=open(log_path, "w"), stderr=subprocess.STDOUT,
+            )
+        )
+    print(
+        f"FLEET launched: {args.nproc} workers, coordinator :{port}, "
+        f"pids {[p.pid for p in procs]}",
+        flush=True,
+    )
+
+    prev = {}
+    for s in (signal.SIGTERM, signal.SIGINT):
+        prev[s] = signal.signal(s, _handle_term)
+    relayed = False
+    try:
+        while any(p.poll() is None for p in procs):
+            if _terminate["flag"] and not relayed:
+                relayed = True
+                print("FLEET relaying SIGTERM to workers", flush=True)
+                for p in procs:
+                    if p.poll() is None:
+                        try:
+                            p.send_signal(signal.SIGTERM)
+                        except OSError:
+                            pass
+            time.sleep(0.1)
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+        for p in procs:
+            if p.poll() is None:  # never orphan a worker
+                p.kill()
+                p.wait()
+
+    rcs = [p.returncode for p in procs]
+    for log_path in logs:
+        with open(log_path) as f:
+            sys.stdout.write(f.read())
+    sys.stdout.flush()
+
+    # per-worker DRIVER lines -> the bit-identity evidence input
+    workers = []
+    for i, log_path in enumerate(logs):
+        entry = {"process": i, "rc": rcs[i]}
+        with open(log_path) as f:
+            for line in f:
+                if line.startswith("DRIVER "):
+                    entry["step"] = int(line.split("step=")[1].split()[0])
+                    entry["digest"] = float(
+                        line.split("digest=")[1].split()[0]
+                    )
+                    entry["save_folder"] = line.split("save_folder=")[
+                        1
+                    ].strip()
+        workers.append(entry)
+
+    if all(rc == 0 for rc in rcs):
+        with open(result_json, "w") as f:
+            json.dump(
+                {
+                    "nproc": args.nproc,
+                    "epochs": args.epochs,
+                    "resume": args.resume,
+                    "share_hint": share_hint,
+                    "straggler_armed": armed,
+                    "workers": workers,
+                },
+                f, indent=1,
+            )
+        print(f"FLEET done: {result_json}", flush=True)
+        sys.exit(0)
+    if 75 in rcs:
+        print("FLEET preempted (exit 75, state saved)", flush=True)
+        sys.exit(75)
+    bad = next(rc for rc in rcs if rc != 0)
+    sys.exit(128 - bad if bad < 0 else bad)
+
+
+if __name__ == "__main__":
+    main()

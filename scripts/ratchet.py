@@ -216,6 +216,22 @@ CONFIGS = {
     # rides the default list.
     "invariant_lint": dict(model=None, epochs=0, bar=None,
                            kind="invariant_lint", dataset=None),
+    # round 16: the straggler-mitigation / composed-chaos gate. Binds on
+    # the COMMITTED evidence artifact (docs/evidence/chaos_matrix_r16.json,
+    # produced by scripts/supervisor_matrix.py --scenarios straggler chaos):
+    # the straggler leg drove a REAL 2-process gloo fleet
+    # (scripts/fleet_launcher.py) from injected 150 ms boundary skew
+    # through the K-of-N persistence verdict to an actuated mitigation —
+    # graceful preempt, restart_rebalanced carrying the share hint into
+    # the relaunched fleet, final parameter digests bit-identical to a
+    # policy-off control; the chaos leg landed straggler + SIGKILL +
+    # injected health collapse green in one supervised lifetime. The pure
+    # chaos_gate_record re-verifies all of it; re-produce the artifact
+    # with the matrix script when the mitigation surface changes.
+    # Instant, so it rides the default list.
+    "chaos_matrix": dict(model=None, epochs=0, bar=None, kind="chaos_gate",
+                         dataset=None,
+                         artifact="docs/evidence/chaos_matrix_r16.json"),
 }
 
 # CPU-calibrated bar for the health_report smoke's online probe: best
@@ -644,6 +660,84 @@ def supervisor_gate_record(artifact):
     devices = resize.get("launch_devices") or []
     if len(set(d for d in devices if d)) < 2:
         return fail(f"resize scenario launch_devices {devices} never changed")
+    record["ok"] = True
+    return record
+
+
+# the straggler-mitigation scenarios the chaos matrix must prove, with the
+# decision sequence each must have produced (scripts/supervisor_matrix.py
+# CHAOS_NAMES expectations, re-checked here so a hand-edited artifact
+# cannot pass) — docs/RESILIENCE.md straggler section
+CHAOS_SCENARIOS = {
+    "straggler": ["restart_rebalanced", "done"],
+    "chaos": ["restart_rebalanced", "backoff_restart", "done"],
+}
+
+
+def chaos_gate_record(artifact):
+    """Gate decision for the straggler-mitigation / composed-chaos evidence
+    (pure — tested without running a fleet).
+
+    Binds everywhere, hardware-independently (the supervisor_gate
+    convention): the claims are decision sequences, recorded mitigation
+    events, and digest equality — not timings. Checks: both scenarios of
+    :data:`CHAOS_SCENARIOS` are present and ``ok`` with exactly their
+    expected decision sequence and exit 0; the straggler leg recorded
+    per-boundary findings, a persistence verdict, BOTH mitigation phases
+    (preempt and decided), carried the rebalance share hint into a
+    relaunch, and its final parameter digests match the policy-off
+    control bit-for-bit; the chaos leg absorbed a real SIGKILL and kept
+    health alarms on the record throughout.
+    """
+    scenarios = artifact.get("scenarios", {})
+    record = {
+        "metric": "ratchet_chaos_matrix",
+        "value": len(scenarios),
+        "scenarios": sorted(scenarios),
+    }
+
+    def fail(msg):
+        record["ok"] = False
+        record["error"] = msg
+        return record
+
+    if artifact.get("schema") != "chaos_matrix/v1":
+        return fail(f"unexpected schema {artifact.get('schema')!r}")
+    for name, expected in CHAOS_SCENARIOS.items():
+        rec = scenarios.get(name)
+        if rec is None:
+            return fail(f"scenario {name!r} missing from the chaos artifact")
+        if not rec.get("ok"):
+            return fail(f"scenario {name!r} not ok in the chaos artifact")
+        if rec.get("decisions") != expected:
+            return fail(
+                f"scenario {name!r} decisions {rec.get('decisions')} != "
+                f"expected {expected}"
+            )
+        if rec.get("rc") != 0:
+            return fail(f"scenario {name!r} did not land green (rc "
+                        f"{rec.get('rc')})")
+        if rec.get("mitigation_events", 0) < 2:
+            return fail(f"scenario {name!r} lacks both mitigation phases "
+                        "(preempt + decided)")
+    strag = scenarios["straggler"]
+    if not (strag.get("straggler_findings")
+            and strag.get("persistence_verdicts")):
+        return fail("straggler scenario lacks finding/persistence evidence")
+    hint = strag.get("share_hint_carried")
+    if not (hint and hint in (strag.get("launch_shares") or [])):
+        return fail("straggler scenario never carried the rebalance share "
+                    "hint into a relaunch")
+    if not strag.get("bit_identical"):
+        return fail(
+            f"mitigated digests {strag.get('digests')} != policy-off "
+            f"control {strag.get('control_digests')}"
+        )
+    chaos = scenarios["chaos"]
+    if not chaos.get("killed_pid"):
+        return fail("chaos scenario recorded no SIGKILLed pid")
+    if not chaos.get("health_alarms_observed"):
+        return fail("chaos scenario recorded no observed health_alarm")
     record["ok"] = True
     return record
 
@@ -1135,6 +1229,24 @@ def run_config(name, spec, epochs, bar, args):
         print(json.dumps(record), flush=True)
         return record
 
+    if kind == "chaos_gate":
+        # binds on the COMMITTED straggler/chaos evidence artifact (see
+        # the CONFIGS note): no subprocess — re-run the matrix's chaos
+        # scenarios when the mitigation surface changes
+        path = os.path.join(REPO, spec["artifact"])
+        try:
+            with open(path) as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ConfigFailed(
+                f"no readable chaos evidence at {path}: {e}"
+            ) from e
+        record = chaos_gate_record(artifact)
+        record["bar"] = bar
+        record["artifact"] = spec["artifact"]
+        print(json.dumps(record), flush=True)
+        return record
+
     if kind == "ce":
         # the CE trainer end-to-end: train + validate in one driver
         # (protocol of docs/evidence/ce_30ep.log: rn50, lr 0.1 cosine, bf16)
@@ -1238,6 +1350,8 @@ def main():
                 metric = "ratchet_health_report"
             elif spec["kind"] == "supervisor_gate":
                 metric = "ratchet_supervisor_matrix"
+            elif spec["kind"] == "chaos_gate":
+                metric = "ratchet_chaos_matrix"
             elif spec["kind"] == "fleet_report":
                 metric = "ratchet_fleet_report"
             elif spec["kind"] == "perf_ledger":

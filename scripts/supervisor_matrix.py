@@ -28,19 +28,43 @@ one-shot injectable faults):
   the elastic-resume proof (mesh-shape-agnostic restore,
   utils/checkpoint.py) driven end to end.
 
-Each scenario prints one JSON line and lands in the artifact with its
+Two further scenarios land in a SEPARATE artifact
+(``docs/evidence/chaos_matrix_r16.json``, verified by ratchet's
+``chaos_matrix`` config) — the straggler-mitigation proof:
+
+- ``straggler``: the supervisor babysits a REAL 2-process gloo fleet
+  (``scripts/fleet_launcher.py`` wrapping ``tests/multiprocess_child.py``
+  driver mode) whose process 1 is paced 150 ms at every boundary
+  allgather; the REAL skew gauges cross the sidecar, the K-of-N detector
+  declares persistence, and mitigation actuates end to end: graceful
+  preempt -> fleet-wide exit 75 -> ``restart_rebalanced`` carrying
+  ``FLEET_SHARE_HINT`` into the relaunched fleet's environment -> done.
+  A policy-off control run of the same launcher proves the mitigated
+  run's final parameter digests are bit-identical — mitigation changes
+  WHERE work runs, never WHAT is computed;
+- ``chaos``: the composed run — straggler skew AND a SIGKILL AND an
+  injected representation-health collapse (under ``--health_policy
+  warn``) in ONE supervised lifetime; the supervisor must drive
+  rebalance, then absorb the kill, then land the fleet green —
+  ``restart_rebalanced`` -> ``backoff_restart`` -> ``done``, exit 0,
+  health alarms on the record throughout.
+
+Each scenario prints one JSON line and lands in its artifact with its
 decision sequence, exit code, and the supervisor events file it came from.
 
 Usage:
     python scripts/supervisor_matrix.py --json docs/evidence/supervisor_r11.json
-    python scripts/supervisor_matrix.py --scenarios sigkill stall
+    python scripts/supervisor_matrix.py --scenarios straggler chaos \
+        --chaos_json docs/evidence/chaos_matrix_r16.json
 """
 
 import argparse
 import json
 import os
+import shutil
 import signal
 import socket
+import subprocess
 import sys
 import threading
 import time
@@ -57,6 +81,7 @@ from simclr_pytorch_distributed_tpu.supervise.launch import (  # noqa: E402
 )
 
 VICTIM = os.path.join(REPO, "scripts", "supervisor_victim.py")
+LAUNCHER = os.path.join(REPO, "scripts", "fleet_launcher.py")
 WAIT_S = 600.0  # per-wait ceiling (cold sharded compiles on a slow host)
 
 
@@ -244,12 +269,164 @@ def scenario_preempt_resize(base, devices_before=8, devices_after=4):
     return rec
 
 
+def _fleet_cmd(wd, epochs, **kw):
+    cmd = [sys.executable, LAUNCHER, "--workdir", wd,
+           "--epochs", str(epochs), "--nproc", "2"]
+    for k, v in kw.items():
+        cmd += [f"--{k}", str(v)]
+    return cmd
+
+
+def scenario_straggler(base):
+    """Real gloo 2-process fleet: injected 150 ms boundary skew ->
+    persistence verdict -> mitigation preempt -> rebalanced relaunch ->
+    done, with a policy-off control run proving bit-identity."""
+    wd = os.path.join(base, "straggler")
+    # fresh workdir: a stale one-shot marker from a previous run would
+    # silently disarm the injection and the scenario would hang waiting
+    # for a mitigation that never comes
+    shutil.rmtree(wd, ignore_errors=True)
+    os.makedirs(wd, exist_ok=True)
+    port = _free_port()
+    epochs = 6
+    cfg = SuperviseConfig(
+        command=_fleet_cmd(
+            wd, epochs, metrics_port=port, straggler_ms=150,
+            straggler_pid=1,
+            straggler_marker=os.path.join(wd, "straggler.marker"),
+        ),
+        workdir=wd, max_restarts=3, backoff_base_s=0.2, poll_s=0.25,
+        # bar 0.05s under the injected ~0.15s skew; K=3 of 5 boundaries
+        # (the driver crosses ~2 flush boundaries per epoch at
+        # print_freq=2, and the first publishes no skew — one-boundary
+        # staleness — so the verdict lands around epoch 2 of 6, strictly
+        # mid-run); generous grace covers SIGTERM -> collective preempt
+        # decision -> fleet emergency save -> exit 75
+        straggler_skew_secs=0.05, straggler_persist_k=3,
+        straggler_window_n=5, straggler_mitigate=True,
+        grace_secs=120.0, metrics_port=port,
+    )
+    sup, join = _run_supervisor(cfg)
+    rc = join()
+    rec, events = _record(
+        "straggler", sup, rc, ["restart_rebalanced", "done"],
+    )
+    findings = [e for e in events if e["name"] == "straggler_finding"]
+    verdicts = [e for e in events if e["name"] == "straggler_persistent"]
+    mitigations = [e for e in events if e["name"] == "straggler_mitigation"]
+    rec["straggler_findings"] = len(findings)
+    rec["persistence_verdicts"] = len(verdicts)
+    rec["mitigation_events"] = len(mitigations)
+    # the relaunched fleet must have been LAUNCHED under the rebalance
+    # hint, and the launcher must have seen it in its environment
+    launches = [e["args"] for e in events if e["name"] == "launch"]
+    rec["launch_shares"] = [la.get("share") for la in launches]
+    result_path = os.path.join(wd, "fleet_result.json")
+    result = json.load(open(result_path)) if os.path.exists(result_path) else {}
+    rec["share_hint_carried"] = result.get("share_hint", "")
+    hint_ok = bool(
+        rec["share_hint_carried"]
+        and rec["share_hint_carried"] in rec["launch_shares"]
+    )
+    # bit-identity: the SAME fleet, unsupervised and uninjected, must land
+    # on the SAME final parameter digests — mitigation (preempt, resume,
+    # rebalance hint) changes where work runs, never what is computed
+    wd_c = os.path.join(base, "straggler_control")
+    shutil.rmtree(wd_c, ignore_errors=True)
+    os.makedirs(wd_c, exist_ok=True)
+    with open(os.path.join(wd_c, "control.log"), "w") as log:
+        subprocess.run(
+            _fleet_cmd(wd_c, epochs), check=True, cwd=REPO,
+            stdout=log, stderr=subprocess.STDOUT, timeout=WAIT_S,
+        )
+    control = json.load(open(os.path.join(wd_c, "fleet_result.json")))
+    digests = [w.get("digest") for w in result.get("workers", [])]
+    control_digests = [w.get("digest") for w in control["workers"]]
+    rec["digests"] = digests
+    rec["control_digests"] = control_digests
+    rec["bit_identical"] = bool(digests and digests == control_digests)
+    rec["ok"] = bool(
+        rec["ok"] and rc == 0 and findings and verdicts
+        and len(mitigations) >= 2   # phase=preempt AND phase=decided
+        and hint_ok and rec["bit_identical"]
+    )
+    return rec
+
+
+def scenario_chaos(base):
+    """The composed run: straggler skew + SIGKILL + injected health
+    collapse (policy warn) in one supervised lifetime, landed green."""
+    wd = os.path.join(base, "chaos")
+    shutil.rmtree(wd, ignore_errors=True)  # stale marker = disarmed fault
+    os.makedirs(wd, exist_ok=True)
+    port = _free_port()
+    cfg = SuperviseConfig(
+        # the victim straggles 150 ms per boundary (one-shot marker: the
+        # mitigation relaunch runs clean) AND its health thresholds are
+        # impossible — but under --health_policy warn collapse only
+        # alarms, it never aborts, so the supervisor must keep the run
+        # alive through all three injected failures
+        command=_victim_cmd(
+            wd, epochs=6, trial="chaos", save_freq=1, metrics_port=port,
+            straggler_ms=150,
+            straggler_marker=os.path.join(wd, "straggler.marker"),
+            fault="collapse", health_freq=2, health_policy="warn",
+        ),
+        workdir=wd, max_restarts=3, backoff_base_s=0.2, poll_s=0.25,
+        straggler_skew_secs=0.05, straggler_persist_k=3,
+        straggler_window_n=5, straggler_mitigate=True,
+        grace_secs=120.0, metrics_port=port,
+    )
+    sup, join = _run_supervisor(cfg)
+    # first life: wait for the mitigation to have actuated (decision 1
+    # recorded, relaunched child alive), then SIGKILL the SECOND life —
+    # the mitigated fleet must also survive an unrelated hard death
+    first_pid = _wait_for(
+        lambda: sup.child and sup.child.pid, "first child pid"
+    )
+    def relaunched():
+        if not sup.decisions:
+            return None
+        if sup.decisions[0].action != "restart_rebalanced":
+            return None
+        child = sup.child
+        if child and child.pid != first_pid and child.poll() is None:
+            return child.pid
+        return None
+    second_pid = _wait_for(relaunched, "rebalanced relaunch")
+    os.kill(second_pid, signal.SIGKILL)
+    rc = join()
+    rec, events = _record(
+        "chaos", sup, rc,
+        ["restart_rebalanced", "backoff_restart", "done"],
+        detail={"killed_pid": second_pid},
+    )
+    alarms = [
+        e for e in events
+        if e["name"] == "trainer_event"
+        and e.get("args", {}).get("event") == "health_alarm"
+    ]
+    mitigations = [e for e in events if e["name"] == "straggler_mitigation"]
+    rec["health_alarms_observed"] = len(alarms)
+    rec["mitigation_events"] = len(mitigations)
+    rec["ok"] = bool(
+        rec["ok"] and rc == 0 and alarms and len(mitigations) >= 2
+    )
+    return rec
+
+
 SCENARIOS = {
     "sigkill": scenario_sigkill,
     "stall": scenario_stall,
     "collapse": scenario_collapse,
     "preempt_resize": scenario_preempt_resize,
+    "straggler": scenario_straggler,
+    "chaos": scenario_chaos,
 }
+# the straggler-mitigation scenarios land in their own artifact (ratchet's
+# chaos_matrix config) so the r11 supervisor artifact stays byte-stable
+CHAOS_NAMES = ("straggler", "chaos")
+CHAOS_SCHEMA = "chaos_matrix/v1"
 
 
 def run_matrix(base, names):
@@ -262,29 +439,52 @@ def run_matrix(base, names):
         rec = SCENARIOS[name](base)
         print(json.dumps(rec), flush=True)
         scenarios[name] = rec
-    return {
-        "metric": "supervisor_matrix",
-        "victim": os.path.relpath(VICTIM, REPO),
-        "scenarios": scenarios,
-        "ok": all(r["ok"] for r in scenarios.values()),
-    }
+    return scenarios
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workdir",
                     default=os.path.join(REPO, "work_space", "supervisor_matrix"))
-    ap.add_argument("--json", default="")
+    ap.add_argument("--json", default="",
+                    help="supervisor_matrix artifact (the four r11 "
+                         "scenarios)")
+    ap.add_argument("--chaos_json", default="",
+                    help="chaos_matrix artifact (the straggler/chaos "
+                         "scenarios)")
     ap.add_argument("--scenarios", nargs="+", default=list(SCENARIOS),
                     choices=list(SCENARIOS))
     args = ap.parse_args()
     os.makedirs(args.workdir, exist_ok=True)
-    artifact = run_matrix(args.workdir, args.scenarios)
-    print(json.dumps({"metric": "supervisor_matrix", "ok": artifact["ok"]}))
-    if args.json:
+    # fresh-artifact convention (scripts/ratchet.py): a failed producer
+    # must never leave a stale green artifact for the gate to re-verify
+    for path in (args.json, args.chaos_json):
+        if path and os.path.exists(path):
+            os.remove(path)
+    scenarios = run_matrix(args.workdir, args.scenarios)
+    ok = all(r["ok"] for r in scenarios.values())
+    print(json.dumps({"metric": "supervisor_matrix", "ok": ok}))
+    legacy = {k: v for k, v in scenarios.items() if k not in CHAOS_NAMES}
+    chaos = {k: v for k, v in scenarios.items() if k in CHAOS_NAMES}
+    if args.json and legacy:
         with open(args.json, "w") as f:
-            json.dump(artifact, f, indent=1)
-    sys.exit(0 if artifact["ok"] else 1)
+            json.dump({
+                "metric": "supervisor_matrix",
+                "victim": os.path.relpath(VICTIM, REPO),
+                "scenarios": legacy,
+                "ok": all(r["ok"] for r in legacy.values()),
+            }, f, indent=1)
+    if args.chaos_json and chaos:
+        with open(args.chaos_json, "w") as f:
+            json.dump({
+                "metric": "chaos_matrix",
+                "schema": CHAOS_SCHEMA,
+                "victim": os.path.relpath(VICTIM, REPO),
+                "launcher": os.path.relpath(LAUNCHER, REPO),
+                "scenarios": chaos,
+                "ok": all(r["ok"] for r in chaos.values()),
+            }, f, indent=1)
+    sys.exit(0 if ok else 1)
 
 
 if __name__ == "__main__":

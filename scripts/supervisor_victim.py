@@ -22,7 +22,15 @@ failure shape the supervisor exists to absorb):
 - ``--fault collapse``: the health thresholds are made impossible
   (``eff_rank_min=1e9``), so the first health window alarms and
   ``--health_policy abort`` exits with typed code 3 (no marker: collapse
-  is not transient, and the supervisor must GIVE UP, not relaunch).
+  is not transient, and the supervisor must GIVE UP, not relaunch);
+- ``--straggler_ms`` (orthogonal to ``--fault``, own ``--straggler_marker``
+  one-shot gate): paces every flush boundary by that much and publishes
+  the fleet-skew gauges a 2-host fleet with a host this slow would expose
+  (a single-process victim has no peers — utils/telemetry.py publishes
+  zero skew — so the injection simulates the fleet view; the REAL gloo
+  skew path is the matrix's 2-process straggler scenario). This is the
+  uniform straggler fault the matrix drives next to stall/nan/collapse,
+  and it composes with them for the chaos scenario.
 
 Accepts main_supcon-style flags (``--resume`` included), so the
 supervisor's appended ``--resume <run_dir>`` lands exactly as it would on
@@ -55,6 +63,24 @@ def parse_args(argv=None):
     p.add_argument("--fault_marker", default="",
                    help="one-shot gate: fault fires only while this file "
                         "is absent (it is created at injection time)")
+    p.add_argument("--straggler_ms", type=float, default=0.0,
+                   help="make THIS process a straggler: sleep this long at "
+                        "every flush-boundary failure-code allgather and "
+                        "publish the matching fleet-skew gauges (a "
+                        "single-process victim has no peers, so "
+                        "utils/telemetry.py publishes zero skew — the "
+                        "injection simulates the 2-host fleet whose host "
+                        "1 is this slow; the REAL multi-process skew "
+                        "path is proven by the gloo straggler scenario). "
+                        "Composable with --fault: the chaos scenario "
+                        "drives straggler + collapse in one run")
+    p.add_argument("--straggler_marker", default="",
+                   help="one-shot gate for --straggler_ms (separate from "
+                        "--fault_marker so the combination stays "
+                        "independent): skew fires only while this file "
+                        "is absent; created at the first injected "
+                        "boundary, so the supervisor's relaunch runs "
+                        "clean — the rebalanced-away shape")
     return p.parse_args(argv)
 
 
@@ -130,13 +156,50 @@ def main(argv=None):
         supcon_driver.check_finite_loss = poisoned_check
     elif armed and args.fault == "collapse":
         # impossible bar: every healthy window "collapses"; under
-        # --health_policy abort the run exits with typed code 3
+        # --health_policy abort the run exits with typed code 3. Patch
+        # the recipe-threshold resolver, not the HealthThresholds class:
+        # RECIPE_HEALTH_THRESHOLDS holds prebuilt instances, so a class
+        # patch never reaches the monitor for a known recipe (obs.py
+        # imports the resolver at run setup, after this patch lands)
         real_thresholds = guard.HealthThresholds
-        guard.HealthThresholds = (
-            lambda **kw: real_thresholds(**{"eff_rank_min": 1e9, **kw})
+        guard.thresholds_for_recipe = (
+            lambda recipe: real_thresholds(eff_rank_min=1e9)
         )
         trip_marker()
         print("FAULT collapse: impossible health thresholds", flush=True)
+
+    straggler_armed = args.straggler_ms > 0 and not (
+        args.straggler_marker and os.path.exists(args.straggler_marker)
+    )
+    if straggler_armed:
+        import time as _time
+
+        from simclr_pytorch_distributed_tpu.utils import telemetry
+
+        real_check = telemetry.TelemetrySession.check_failures_global
+        skew_s = args.straggler_ms / 1e3
+
+        def skewed_check(self, step_hint=0):
+            # marker trips at the FIRST injected boundary (injection
+            # time), so the relaunch of this same command runs clean
+            if args.straggler_marker and not os.path.exists(
+                args.straggler_marker
+            ):
+                with open(args.straggler_marker, "w") as f:
+                    f.write(f"straggler {args.straggler_ms}ms")
+                print("FAULT straggler: boundary skew armed", flush=True)
+            _time.sleep(skew_s)  # genuinely pace the boundary
+            real_check(self, step_hint)
+            if self._gauges is not None:
+                # what a 2-host fleet with host 1 this slow would publish
+                # (utils/telemetry.py multi-process branch)
+                self._gauges.set(
+                    boundary_skew_seconds=skew_s,
+                    boundary_straggler=1.0,
+                    process_count=2.0,
+                )
+
+        telemetry.TelemetrySession.check_failures_global = skewed_check
 
     cfg = config_lib.SupConConfig(
         model="resnet10", dataset="synthetic", batch_size=32,

@@ -92,10 +92,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "Set well above the first-step compile AND the "
                         "trainer's own --watchdog_secs")
     p.add_argument("--straggler_skew_secs", type=float, default=1.0,
-                   help="boundary-skew bar for the WARN-ONLY straggler "
-                        "finding scraped off the child's "
+                   help="boundary-skew bar for the straggler finding "
+                        "scraped off the child's "
                         "train_boundary_skew_seconds gauge (0 = off); "
-                        "recorded to the supervisor timeline, never a kill")
+                        "findings feed the K-of-N persistence detector")
+    p.add_argument("--straggler_persist_k",
+                   type=positive_int_arg("straggler_persist_k"), default=3,
+                   help="boundaries (of the last --straggler_window_n) that "
+                        "must name the SAME host above the bar before the "
+                        "straggler is PERSISTENT (>= 2 gives hysteresis: a "
+                        "one-boundary GC pause never triggers)")
+    p.add_argument("--straggler_window_n",
+                   type=positive_int_arg("straggler_window_n"), default=5,
+                   help="sliding window of boundaries the K-of-N vote "
+                        "runs over")
+    p.add_argument("--straggler_mitigate", action="store_true",
+                   default=False,
+                   help="act on a persistence verdict: graceful preempt + "
+                        "the escalation ladder restart_rebalanced -> "
+                        "restart_resized (exclude) -> give_up, budget-"
+                        "capped, never over a pending operator resize. "
+                        "Default: record the verdict, take no action")
     p.add_argument("--grace_secs", type=float, default=20.0,
                    help="SIGTERM->SIGKILL window on a supervisor-initiated "
                         "kill (the preemption machinery's chance to save)")
@@ -142,6 +159,9 @@ def main(argv=None) -> int:
         poll_s=args.poll_secs,
         stall_secs=args.stall_secs,
         straggler_skew_secs=args.straggler_skew_secs,
+        straggler_persist_k=args.straggler_persist_k,
+        straggler_window_n=args.straggler_window_n,
+        straggler_mitigate=args.straggler_mitigate,
         grace_secs=args.grace_secs,
         metrics_port=args.metrics_port,
         metrics_host=args.metrics_host,

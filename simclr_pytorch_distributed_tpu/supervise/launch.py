@@ -18,6 +18,16 @@ resize is a scheduler-level relaunch onto a different slice — the supervisor
 still makes the restart-resized DECISION and records it; the env hook is the
 single-host realization. Checkpoint restore being mesh-shape-agnostic
 (utils/checkpoint.py) is what makes the relaunch legal either way.
+
+Share (:func:`share_env`): "relaunch rebalanced" — the straggler ladder's
+first rung — carries a ``host:factor`` hint (``FLEET_SHARE_HINT``) into the
+relaunch: the named host should run at that fraction of its uniform
+per-process share. The same convention as topology: on a real fleet the
+scheduler/launcher layer realizes the hint (fewer examples routed to the
+slow host, the epoch permutation being process-count-independent keeps the
+global stream identical — data/pipeline.EpochLoader); on the single-host
+harness the hint is carried, recorded, and verifiable in the relaunch's
+environment (scripts/fleet_launcher.py echoes it into its result file).
 """
 
 from __future__ import annotations
@@ -103,6 +113,28 @@ def topology_env(
     return env
 
 
+# the rebalance hint a restart_rebalanced relaunch carries: "<host>:<factor>"
+# (which process runs at what fraction of its uniform share)
+FLEET_SHARE_ENV = "FLEET_SHARE_HINT"
+
+
+def share_env(
+    share: Optional[str], base_env: Optional[Dict[str, str]] = None
+) -> Dict[str, str]:
+    """The child env for a given rebalance hint (module docstring).
+
+    ``share=None`` REMOVES any stale hint rather than inheriting it: after
+    the exclusion rung (or an operator resize) the shares are uniform
+    again across the new topology, and a hint left over from an earlier
+    rebalance would silently starve a host that is no longer slow."""
+    env = dict(os.environ if base_env is None else base_env)
+    if share:
+        env[FLEET_SHARE_ENV] = str(share)
+    else:
+        env.pop(FLEET_SHARE_ENV, None)
+    return env
+
+
 def build_command(
     command: Sequence[str], resume_dir: Optional[str]
 ) -> List[str]:
@@ -128,13 +160,16 @@ class Child:
         command: Sequence[str],
         resume_dir: Optional[str] = None,
         devices: Optional[int] = None,
+        share: Optional[str] = None,
         cwd: Optional[str] = None,
     ):
         self.command = build_command(command, resume_dir)
         self.devices = devices
+        self.share = share
         self.resume_dir = resume_dir
         self.proc = subprocess.Popen(
-            self.command, env=topology_env(devices), cwd=cwd
+            self.command, env=share_env(share, topology_env(devices)),
+            cwd=cwd,
         )
 
     @property

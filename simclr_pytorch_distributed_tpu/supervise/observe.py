@@ -87,24 +87,39 @@ class MetricsScraper:
 
 # the fleet-skew gauges the trainer sidecar exposes: utils/telemetry.py
 # stamps them at each flush-boundary failure-code allgather (the skew is
-# the spread of per-host waits piggybacked on that collective)
+# the spread of per-host waits piggybacked on that collective; the
+# straggler gauge is argmin(wait) — the host everyone else waited on —
+# and process_count is the fleet size that wait vector came from)
 SKEW_GAUGE = "train_boundary_skew_seconds"
 WAIT_GAUGE = "train_collective_wait_seconds"
+STRAGGLER_GAUGE = "train_boundary_straggler"
+PROC_COUNT_GAUGE = "train_process_count"
 
 
 def straggler_finding(
     gauges: Optional[Dict[str, float]], skew_bar_s: float
 ) -> Optional[dict]:
-    """A WARN-ONLY straggler observation from one sidecar scrape, or None.
+    """One boundary's straggler observation from one sidecar scrape, or None.
 
     Fires when ``train_boundary_skew_seconds`` (the fleet's boundary
-    arrival spread) is at/above ``skew_bar_s``: some process is
-    consistently late to the collectives and the whole synchronous step is
-    paced by it. The supervisor RECORDS the finding (who/when/how much)
-    but takes no action — today's policy table has no straggler remedy
-    (resize away from the slow host, re-shard, abort); the recorded
-    finding is the input a future policy row can act on, the same way
-    stall dumps preceded the liveness-kill row."""
+    arrival spread) is at/above ``skew_bar_s``: some process is late to
+    the collectives and the whole synchronous step is paced by it. One
+    finding is one BOUNDARY, not a verdict: transient skew (a GC pause, a
+    noisy neighbor) is normal, so acting on a single finding would thrash.
+    :class:`StragglerTracker` folds the per-boundary findings into a
+    K-of-N persistence verdict, and the policy ladder
+    (supervise/policy.py: warn -> restart_rebalanced -> restart_resized ->
+    give_up) acts on THAT.
+
+    Beyond the skew itself the finding carries the REBALANCE CONTEXT when
+    the sidecar exposes it (a PR-16 trainer): ``straggler`` — which
+    process the fleet waited on (``train_boundary_straggler``, -1/absent
+    on a single process); ``processes`` — the fleet size; and ``share`` —
+    the straggler's current per-process share of the global batch
+    (``1/processes``; data/pipeline.EpochLoader slices uniform contiguous
+    blocks), the quantity a ``restart_rebalanced`` decision shrinks.
+    Against an older sidecar without those gauges the finding still fires
+    but carries no identity — enough to warn, not enough to mitigate."""
     if not gauges or skew_bar_s <= 0:
         return None
     skew = gauges.get(SKEW_GAUGE)
@@ -114,7 +129,112 @@ def straggler_finding(
     for key, name in ((WAIT_GAUGE, "wait_s"), ("train_step", "step")):
         if key in gauges:
             finding[name] = gauges[key]
+    straggler = gauges.get(STRAGGLER_GAUGE)
+    if straggler is not None and straggler >= 0:
+        finding["straggler"] = int(straggler)
+    processes = gauges.get(PROC_COUNT_GAUGE)
+    if processes is not None and processes > 0:
+        finding["processes"] = int(processes)
+        finding["share"] = 1.0 / int(processes)
     return finding
+
+
+# sentinel: "no boundary deduped yet" (train_step may legitimately be
+# absent from a scrape — a None step must still dedup correctly)
+_NO_STEP = object()
+
+
+class StragglerTracker:
+    """Per-boundary straggler findings -> a K-of-N PERSISTENCE verdict.
+
+    ``observe(gauges)`` is fed every scrape; it returns the boundary's
+    finding exactly once per boundary (the skew gauge holds its value
+    between flush boundaries, so scrapes are deduplicated on the
+    ``train_step`` gauge) and maintains a sliding window of the last
+    ``window_n`` boundaries. A straggler is declared PERSISTENT — exposed
+    by :meth:`take_persistent` — only when at least ``persist_k`` of those
+    boundaries named the SAME host above the bar. That hysteresis is the
+    point: one boundary of skew (a GC pause, a checkpoint fsync, a noisy
+    neighbor burst) never triggers, and a straggler identity that hops
+    between hosts (load imbalance, not a sick host) never accumulates K
+    votes for any one of them.
+
+    Single-process runs are ALWAYS benign: without the identity gauges
+    (``train_boundary_straggler`` >= 0 and ``train_process_count`` > 1)
+    a boundary contributes no vote — there is no host to rebalance away
+    from, and utils/telemetry.py publishes zero skew anyway.
+
+    ``clock`` is injectable (the supervisor passes its own): verdict
+    timestamps come from it, never from ``time`` directly, so the loop
+    tests drive the tracker without real waiting. ``take_persistent``
+    consumes the verdict and resets the window — the supervisor acts once
+    per verdict (or records it once, in warn-only mode), and detection
+    starts fresh for the next attempt via :meth:`reset`.
+    """
+
+    def __init__(
+        self,
+        skew_bar_s: float,
+        persist_k: int = 3,
+        window_n: int = 5,
+        clock=None,
+    ):
+        if persist_k < 1:
+            raise ValueError(f"persist_k must be >= 1, got {persist_k}")
+        if window_n < persist_k:
+            raise ValueError(
+                f"need window_n >= persist_k, got {window_n}/{persist_k}"
+            )
+        self.skew_bar_s = float(skew_bar_s)
+        self.persist_k = int(persist_k)
+        self.window_n = int(window_n)
+        self._clock = clock if clock is not None else (lambda: 0.0)
+        # sliding window of (straggler-or-None, finding) per NEW boundary
+        self._window: List[tuple] = []
+        self._last_step: object = _NO_STEP
+        self._persistent: Optional[dict] = None
+
+    def reset(self) -> None:
+        """Fresh window + step dedup (a new child attempt restarts its
+        gauge stream; stale votes must not convict the relaunch)."""
+        self._window = []
+        self._last_step = _NO_STEP
+        self._persistent = None
+
+    def observe(self, gauges: Optional[Dict[str, float]]) -> Optional[dict]:
+        """Feed one scrape; returns the finding when this scrape is a NEW
+        boundary at/above the bar (for the supervisor to record), else
+        None. Below-bar boundaries still enter the window — they dilute
+        the vote, which is how a recovered host walks itself back out."""
+        if not gauges or self.skew_bar_s <= 0:
+            return None
+        step = gauges.get("train_step")
+        if step == self._last_step:
+            return None  # same boundary; the gauge holds between beats
+        self._last_step = step
+        finding = straggler_finding(gauges, self.skew_bar_s)
+        host = finding.get("straggler") if finding else None
+        multi = (gauges.get(PROC_COUNT_GAUGE) or 0) > 1
+        vote = host if (finding is not None and host is not None and multi) else None
+        self._window.append((vote, finding))
+        if len(self._window) > self.window_n:
+            self._window.pop(0)
+        if vote is not None:
+            votes = sum(1 for v, _ in self._window if v == vote)
+            if votes >= self.persist_k:
+                self._persistent = dict(
+                    finding, votes=votes, window=len(self._window),
+                    at=self._clock(),
+                )
+        return finding
+
+    def take_persistent(self) -> Optional[dict]:
+        """The pending persistence verdict (finding + ``votes``/``window``/
+        ``at``), or None; consuming it resets the window."""
+        verdict = self._persistent
+        if verdict is not None:
+            self.reset()
+        return verdict
 
 
 class RunDirWatcher:

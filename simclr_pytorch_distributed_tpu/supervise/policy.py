@@ -37,6 +37,26 @@ signal death (rc < 0)       BACKOFF then restart with ``--resume`` —
 supervisor-observed stall   the supervisor killed the child itself
                             (liveness age or a watchdog dump); BACKOFF
                             then restart with ``--resume``
+75 + persistent straggler   the supervisor preempted the child itself
+                            after a K-of-N persistence verdict
+                            (observe.StragglerTracker): the STRAGGLER
+                            LADDER — first verdict RESTART_REBALANCED
+                            (shrink the slow host's share; the epoch
+                            permutation is process-count-independent, so
+                            the stream survives), second RESTART_RESIZED
+                            excluding the slow host (the elastic-resume
+                            path), third GIVE_UP — a host that stays slow
+                            through rebalance AND exclusion means the
+                            diagnosis is wrong, and a human should look.
+                            Never fires over a pending OPERATOR resize
+                            (the explicit request wins), always bounded
+                            by the restart budget, and a run that later
+                            preempts cleanly with no verdict resets the
+                            ladder (recovery). A mitigation preempt the
+                            child did NOT honor (grace lapsed to SIGKILL)
+                            falls through to the signal-death row — the
+                            ladder only advances on the clean exit 75 the
+                            mitigation contract promises
 anything else               BACKOFF then restart — bounded by the budget,
                             so a permanent failure (bad flag, import
                             error) burns at most ``max_restarts`` cheap
@@ -44,10 +64,10 @@ anything else               BACKOFF then restart — bounded by the budget,
 ==========================  =============================================
 
 Restart budget: ``max_restarts`` bounds TOTAL relaunches (the launcher
-loop's ``PREEMPT_RETRIES`` contract, now shared by every failure class).
-Backoff is exponential in CONSECUTIVE failures — a clean preemption resets
-the streak (the fleet is healthy, the scheduler is just busy) — capped at
-``backoff_max_s``.
+loop's ``PREEMPT_RETRIES`` contract, now shared by every failure class —
+straggler mitigations included). Backoff is exponential in CONSECUTIVE
+failures — a clean preemption resets the streak (the fleet is healthy,
+the scheduler is just busy) — capped at ``backoff_max_s``.
 """
 
 from __future__ import annotations
@@ -62,11 +82,17 @@ from simclr_pytorch_distributed_tpu.utils import guard, preempt
 DONE = "done"
 RESTART = "restart_resume"
 RESTART_RESIZED = "restart_resized"
+RESTART_REBALANCED = "restart_rebalanced"
 BACKOFF_RESTART = "backoff_restart"
 GIVE_UP = "give_up"
 # emitted by the SUPERVISOR loop (not decide()): the supervisor itself was
 # SIGTERM/SIGINT'd and relayed the signal to the child instead of relaunching
 SHUTDOWN = "shutdown"
+
+# the rebalance rung's share shrink: the slow host keeps this fraction of
+# its uniform per-process share (the hint launch.share_env carries into the
+# relaunch; on a real fleet the scheduler realizes it, docs/RESILIENCE.md)
+REBALANCE_FACTOR = 0.5
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,24 +107,39 @@ class ExitObservation:
     attempt (forensics context for the decision event; a health ALARM
     under ``--health_policy warn`` does not by itself end a run — only
     the exit code 3 of an ``abort`` policy does).
+
+    ``straggler_persistent`` means the SUPERVISOR gracefully preempted
+    the child after a K-of-N straggler persistence verdict
+    (observe.StragglerTracker) — the mitigation request the ladder acts
+    on when the exit is the clean 75 the preempt contract promises.
+    ``straggler_host``/``straggler_skew_s``/``processes`` carry the
+    verdict's context (who, how slow, out of how many) for the rebalance
+    share and the exclusion topology; -1/0 when unknown.
     """
 
     returncode: int
     stalled: bool = False
     stall_dumps: int = 0
     health_alarms: int = 0
+    straggler_persistent: bool = False
+    straggler_host: int = -1
+    straggler_skew_s: float = 0.0
+    processes: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
 class Decision:
     """What the supervisor does next. ``delay_s`` is slept before the
     relaunch; ``devices`` is the new topology for RESTART_RESIZED (None
-    everywhere else); ``reason`` is the human- and JSON-facing line."""
+    everywhere else); ``share`` is the ``host:factor`` rebalance hint for
+    RESTART_REBALANCED (launch.share_env carries it into the relaunch);
+    ``reason`` is the human- and JSON-facing line."""
 
     action: str
     reason: str
     delay_s: float = 0.0
     devices: Optional[int] = None
+    share: Optional[str] = None
 
 
 class DecisionPolicy:
@@ -126,6 +167,9 @@ class DecisionPolicy:
         self.restarts = 0          # relaunches performed so far
         self.failures = 0          # consecutive non-clean exits (backoff input)
         self.pending_resize: Optional[int] = None
+        # straggler-ladder rung already taken: 0 none (warn territory),
+        # 1 rebalanced, 2 excluded — the NEXT verdict takes rung+1
+        self.straggler_level = 0
 
     # ---------------------------------------------------------------- helpers
     def backoff_s(self) -> float:
@@ -153,6 +197,45 @@ class DecisionPolicy:
                 delay_s=delay_s, devices=devices,
             )
         return Decision(action, reason, delay_s=delay_s)
+
+    def _mitigate_straggler(self, obs: ExitObservation) -> Decision:
+        """The escalation ladder, one rung per persistence verdict. The
+        warn rung is rung 0 and lives OUTSIDE this method: per-boundary
+        findings and the persistence verdict itself are recorded by the
+        supervisor before any preempt (and are the ONLY response in
+        warn-only mode). Reaching here means the supervisor already
+        preempted for mitigation and the child exited cleanly."""
+        self.straggler_level += 1
+        host = obs.straggler_host
+        skew = obs.straggler_skew_s
+        if self.straggler_level == 1:
+            self.restarts += 1
+            return Decision(
+                RESTART_REBALANCED,
+                f"persistent straggler host {host} (skew {skew:.3f}s): "
+                f"rebalancing its share to {REBALANCE_FACTOR:g}x and "
+                f"resuming",
+                share=f"{host}:{REBALANCE_FACTOR:g}",
+            )
+        if self.straggler_level == 2:
+            self.restarts += 1
+            devices = (
+                max(1, obs.processes - 1) if obs.processes > 1 else None
+            )
+            return Decision(
+                RESTART_RESIZED,
+                f"straggler host {host} persists after rebalance (skew "
+                f"{skew:.3f}s): excluding it and resuming on the "
+                f"remaining host(s)",
+                devices=devices,
+            )
+        return Decision(
+            GIVE_UP,
+            f"straggler host {host} persists after rebalance AND "
+            f"exclusion (skew {skew:.3f}s): mitigation ladder exhausted "
+            f"— the slowness is not where the fleet thinks it is; a "
+            f"human should look",
+        )
 
     # ----------------------------------------------------------------- decide
     def decide(self, obs: ExitObservation) -> Decision:
@@ -184,6 +267,25 @@ class DecisionPolicy:
             # in a tight kill/relaunch loop and misattribute the
             # supervisor's own kill as scheduler preemption in post-mortems
             self.failures = 0
+            if obs.straggler_persistent:
+                if self.pending_resize is not None:
+                    # operator-resize precedence: the explicit request
+                    # wins over the inferred mitigation (the supervisor
+                    # also refuses to INITIATE one over a pending resize
+                    # — this row covers the race where both land on the
+                    # same exit); _restart consumes the pending target
+                    return self._restart(
+                        RESTART,
+                        "preempted with a persistent-straggler verdict, "
+                        "but an operator resize is pending: the explicit "
+                        "request wins",
+                    )
+                return self._mitigate_straggler(obs)
+            # a clean, boundary-rich exit with NO verdict in force means
+            # the mitigation (or the fleet) recovered: the ladder resets,
+            # so a straggler relapse much later starts at rebalance again
+            # instead of escalating straight to give_up
+            self.straggler_level = 0
             return self._restart(
                 RESTART, "preempted (exit 75, state saved): resume"
             )

@@ -23,6 +23,18 @@ mesh-shape-agnostic restore (utils/checkpoint.py) reshards the checkpoint
 onto the new mesh. A pending resize also upgrades any other restartable
 exit, so an operator's resize survives an unlucky crash.
 
+Straggler mitigation: the sidecar's boundary-skew gauges feed
+``observe.StragglerTracker``; K-of-N boundaries naming the same host above
+``straggler_skew_secs`` is a PERSISTENCE verdict (always recorded as a
+``straggler_persistent`` event — the warn rung). With
+``straggler_mitigate`` on, a verdict triggers the same graceful-preempt
+machinery as a resize (``straggler_mitigation`` phase=preempt event,
+SIGTERM -> emergency save -> exit 75) and the policy ladder decides the
+relaunch: ``restart_rebalanced`` carrying a ``FLEET_SHARE_HINT`` into the
+environment, then ``restart_resized`` excluding the host, then ``give_up``
+(docs/RESILIENCE.md). A pending operator resize always wins over
+mitigation, and the restart budget caps the ladder like every other class.
+
 Every observation and decision is a span/event in the supervisor's own
 ``events.jsonl`` (``<workdir>/supervise/``, the shared FlightRecorder +
 ``run_paths`` session rotation), so one `jq` pass over trainer + supervisor
@@ -59,10 +71,22 @@ class SuperviseConfig:
     backoff_max_s: float = 60.0
     poll_s: float = 1.0
     stall_secs: float = 0.0          # 0 = no liveness-kill (observe only)
-    # boundary-skew bar (s) for the WARN-ONLY straggler finding scraped off
-    # the child's train_boundary_skew_seconds gauge; 0 = off. Never a kill:
-    # the recorded finding is the input a future policy row can act on.
+    # boundary-skew bar (s) for the per-boundary straggler finding scraped
+    # off the child's train_boundary_skew_seconds gauge; 0 = off. Findings
+    # feed observe.StragglerTracker's K-of-N persistence verdict; what a
+    # verdict DOES depends on straggler_mitigate below.
     straggler_skew_secs: float = 1.0
+    # persistence: a straggler is persistent after straggler_persist_k of
+    # the last straggler_window_n boundaries named the SAME host above the
+    # bar (hysteresis: one boundary of skew — a GC pause — never triggers)
+    straggler_persist_k: int = 3
+    straggler_window_n: int = 5
+    # False (default): verdicts are RECORDED (straggler_persistent events
+    # — the warn rung of the ladder) but never acted on, the pre-PR-16
+    # behavior. True: a verdict triggers a graceful mitigation preempt and
+    # the policy ladder (rebalance -> exclude -> give_up), budget-capped,
+    # never over a pending operator resize.
+    straggler_mitigate: bool = False
     grace_secs: float = 20.0         # SIGTERM -> SIGKILL window
     metrics_port: int = 0            # the CHILD's sidecar port; 0 = no scrape
     metrics_host: str = "127.0.0.1"
@@ -129,13 +153,23 @@ class Supervisor:
         # default action would orphan the trainer with no grace window and
         # lose the emergency save the whole preempt contract promises)
         self._terminate: Optional[int] = None
-        # last raw sidecar scrape (the straggler finding reads the skew
-        # gauges off the SAME scrape liveness used — one GET per poll) and
-        # the last step a straggler finding was recorded at (the skew
-        # gauge holds its value between boundaries; re-recording it every
-        # poll would spam the supervisor timeline)
+        # last raw sidecar scrape (the straggler tracker reads the skew
+        # gauges off the SAME scrape liveness used — one GET per poll)
         self._last_scrape: Optional[dict] = None
-        self._straggler_step: Optional[float] = None
+        # per-boundary findings -> K-of-N persistence verdicts (the
+        # tracker dedups scrapes of the same boundary internally, so the
+        # supervisor timeline gets one finding per boundary, not per poll)
+        self._straggler = observe.StragglerTracker(
+            cfg.straggler_skew_secs,
+            persist_k=cfg.straggler_persist_k,
+            window_n=cfg.straggler_window_n,
+            clock=clock,
+        )
+        # the verdict a mitigation preempt was issued for (None between),
+        # read by run() into the ExitObservation; and the sticky rebalance
+        # hint carried into every relaunch until cleared by a resize
+        self._mitigation: Optional[dict] = None
+        self._share: Optional[str] = None
 
     # ------------------------------------------------------------- channels
     def _handle_signal(self, signum, frame):  # noqa: ARG002 — handler signature
@@ -221,7 +255,7 @@ class Supervisor:
             "decision", track="supervisor", action=decision.action,
             reason=decision.reason, rc=rc, stalled=stalled,
             delay_s=decision.delay_s, devices=decision.devices,
-            restarts=self.policy.restarts,
+            share=decision.share, restarts=self.policy.restarts,
         )
         logger.warning(
             "supervise decision: %s (%s)", decision.action, decision.reason
@@ -329,22 +363,56 @@ class Supervisor:
                 )
                 return rc, False, stall_dumps, health_alarms
             age = self._liveness_age()
-            finding = observe.straggler_finding(
-                self._last_scrape, cfg.straggler_skew_secs
-            )
-            if finding is not None and finding.get("step") != self._straggler_step:
-                # WARN-ONLY: recorded for the post-mortem (and a future
-                # policy row), never a kill — a straggling pod is slow,
-                # not wedged; once per boundary step, not per poll
-                self._straggler_step = finding.get("step")
+            finding = self._straggler.observe(self._last_scrape)
+            if finding is not None:
+                # one boundary's observation (the warn rung of the
+                # ladder): recorded once per boundary step, not per poll
                 self.recorder.event(
                     "straggler_finding", track="supervisor", **finding
                 )
                 logger.warning(
                     "straggler finding: boundary skew %.3fs >= %.3fs "
-                    "(step %s) — recorded, no action",
+                    "(step %s, straggler %s)",
                     finding["skew_s"], finding["bar_s"],
-                    finding.get("step"),
+                    finding.get("step"), finding.get("straggler"),
+                )
+            verdict = self._straggler.take_persistent()
+            if verdict is not None:
+                # K-of-N boundaries named the same host: a PERSISTENCE
+                # verdict, always recorded. Mitigation only when enabled
+                # AND no operator resize is pending (the explicit request
+                # outranks the inferred remedy — the resize branch above
+                # would already have preempted this poll anyway)
+                self.recorder.event(
+                    "straggler_persistent", track="supervisor",
+                    mitigate=bool(cfg.straggler_mitigate), **verdict
+                )
+                if (cfg.straggler_mitigate
+                        and self.policy.pending_resize is None):
+                    self._mitigation = verdict
+                    self.recorder.event(
+                        "straggler_mitigation", track="supervisor",
+                        phase="preempt", **verdict
+                    )
+                    logger.warning(
+                        "persistent straggler host %s (%d/%d boundaries, "
+                        "skew %.3fs): preempting for mitigation "
+                        "(grace %gs)",
+                        verdict.get("straggler"), verdict.get("votes", 0),
+                        verdict.get("window", 0), verdict["skew_s"],
+                        cfg.grace_secs,
+                    )
+                    rc = self.child.terminate_gracefully(
+                        cfg.grace_secs, sleep=self._sleep, clock=self._clock
+                    )
+                    return rc, False, stall_dumps, health_alarms
+                logger.warning(
+                    "persistent straggler host %s (%d/%d boundaries, skew "
+                    "%.3fs) — recorded, no action (mitigation %s)",
+                    verdict.get("straggler"), verdict.get("votes", 0),
+                    verdict.get("window", 0), verdict["skew_s"],
+                    "off" if not cfg.straggler_mitigate
+                    else "deferred to pending resize",
                 )
             stalled = bool(
                 cfg.stall_secs > 0
@@ -419,7 +487,8 @@ class Supervisor:
                     devices = resize
                 try:
                     self.child = launch.Child(
-                        cfg.command, resume_dir=resume_dir, devices=devices
+                        cfg.command, resume_dir=resume_dir, devices=devices,
+                        share=self._share,
                     )
                 except OSError as e:
                     # an unlaunchable command (typo'd executable, EPERM) is
@@ -442,13 +511,19 @@ class Supervisor:
                 self.recorder.event(
                     "launch", track="supervisor", attempt=attempt,
                     pid=self.child.pid, devices=devices,
+                    share=self._share,
                     resume=resume_dir or "", command=self.child.command,
                 )
                 logger.info(
-                    "supervise: attempt %d pid %d (devices=%s resume=%s)",
+                    "supervise: attempt %d pid %d (devices=%s share=%s "
+                    "resume=%s)",
                     attempt, self.child.pid, devices or "inherit",
-                    resume_dir or "none",
+                    self._share or "uniform", resume_dir or "none",
                 )
+                # fresh detection per attempt: the relaunch restarts its
+                # gauge stream, and stale votes must not convict it
+                self._straggler.reset()
+                self._mitigation = None
                 start = self._clock()
                 rc, stalled, dumps, alarms = self._watch_child()
                 last_rc = rc
@@ -472,12 +547,28 @@ class Supervisor:
                     )
                     self._discard_stale_resize()
                     return _shell_rc(rc)
+                mit = self._mitigation
                 obs = policy.ExitObservation(
                     returncode=rc, stalled=stalled,
                     stall_dumps=dumps, health_alarms=alarms,
+                    straggler_persistent=mit is not None,
+                    straggler_host=int(mit.get("straggler", -1))
+                    if mit else -1,
+                    straggler_skew_s=float(mit.get("skew_s", 0.0))
+                    if mit else 0.0,
+                    processes=int(mit.get("processes", 0)) if mit else 0,
                 )
                 decision = self.policy.decide(obs)
                 self._record_decision(decision, rc, stalled)
+                if mit is not None:
+                    # close the mitigation span on the timeline: what the
+                    # preempt actually bought (a ladder rung, or give_up)
+                    self.recorder.event(
+                        "straggler_mitigation", track="supervisor",
+                        phase="decided", action=decision.action,
+                        share=decision.share, devices=decision.devices,
+                        host=obs.straggler_host,
+                    )
                 if decision.action == policy.DONE:
                     self._discard_stale_resize()
                     return 0
@@ -488,6 +579,13 @@ class Supervisor:
                     self._sleep_interruptible(decision.delay_s)
                 if decision.devices is not None:
                     devices = decision.devices
+                if decision.action == policy.RESTART_REBALANCED:
+                    self._share = decision.share
+                elif decision.action == policy.RESTART_RESIZED:
+                    # exclusion (or an operator resize): shares are
+                    # uniform again across the new topology — a stale
+                    # hint would starve a host that is no longer slow
+                    self._share = None
                 # require_checkpoint: only inject --resume when a COMPLETE
                 # save exists somewhere — an empty newest dir (child died
                 # pre-first-save) would fail resolve_resume_path on every

@@ -449,7 +449,15 @@ class TelemetrySession:
             if len(waits) > 1 and (waits >= 0).all():
                 skew_s = float(waits.max() - waits.min()) / 1e3
                 if self._gauges is not None:
-                    self._gauges.set(boundary_skew_seconds=skew_s)
+                    # skew + the straggler's IDENTITY and the fleet size:
+                    # the supervisor's rebalance/exclude ladder needs to
+                    # know WHO is slow and what share it holds, not just
+                    # that someone is (supervise/observe.StragglerTracker)
+                    self._gauges.set(
+                        boundary_skew_seconds=skew_s,
+                        boundary_straggler=float(waits.argmin()),
+                        process_count=float(len(waits)),
+                    )
                 tracing.event(
                     "boundary_skew", track=tracing.FLEET_TRACK,
                     step=step_hint, skew_s=round(skew_s, 6),
@@ -457,9 +465,12 @@ class TelemetrySession:
                 )
         elif self._gauges is not None:
             # single process: no peers to wait on — publish the keys so a
-            # scraper's dashboard reads 0, not absent
+            # scraper's dashboard reads 0, not absent (straggler identity
+            # -1 = nobody: the supervisor's tracker treats a one-process
+            # "fleet" as always benign)
             self._gauges.set(
-                collective_wait_seconds=0.0, boundary_skew_seconds=0.0
+                collective_wait_seconds=0.0, boundary_skew_seconds=0.0,
+                boundary_straggler=-1.0, process_count=1.0,
             )
         # the matched instant every process just left (or, single-process,
         # a plain deterministic stamp): the fleet report's alignment ruler

@@ -111,6 +111,10 @@ if mode in ("driver", "driver_partial", "ce"):
         learning_rate=0.05, temp=0.5, cosine=True, syncBN=True,
         save_freq=2, print_freq=2, size=8, workdir=workdir, seed=0,
         method="SimCLR", trial="mp", resume=resume,
+        # supervised-fleet hook (scripts/fleet_launcher.py sets the env on
+        # process 0 only): expose the /metrics sidecar so the supervisor
+        # scrapes the REAL gloo fleet's skew gauges
+        metrics_port=int(os.environ.get("CHILD_METRICS_PORT", "0") or 0),
     )
     cfg = config_lib.finalize_supcon(cfg)
 
@@ -132,17 +136,29 @@ if mode in ("driver", "driver_partial", "ce"):
             print(f"PARTIAL save_folder={cfg.save_folder}", flush=True)
             sys.exit(0)
 
-    state = supcon_driver.run(cfg)
-    import jax as _jax
+    def _run_and_print():
+        state = supcon_driver.run(cfg)
+        import jax as _jax
 
-    digest = sum(
-        float(abs(x).sum()) for x in _jax.tree.leaves(state.params)
-    )
-    print(
-        f"DRIVER step={int(state.step)} digest={digest:.6f} "
-        f"save_folder={cfg.save_folder}",
-        flush=True,
-    )
+        digest = sum(
+            float(abs(x).sum()) for x in _jax.tree.leaves(state.params)
+        )
+        print(
+            f"DRIVER step={int(state.step)} digest={digest:.6f} "
+            f"save_folder={cfg.save_folder}",
+            flush=True,
+        )
+
+    if os.environ.get("CHILD_GUARDED"):
+        # supervised-fleet hook: run under the drivers' typed exit-code
+        # surface so a collective preemption leaves as the clean exit 75
+        # the supervisor's preempt contract classifies (without it a
+        # PreemptionError would crash out as a generic rc 1)
+        from simclr_pytorch_distributed_tpu.utils import guard as guard_lib
+
+        guard_lib.exit_with_code(_run_and_print)
+    else:
+        _run_and_print()
     sys.exit(0)
 
 import jax.numpy as jnp

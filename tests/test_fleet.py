@@ -318,6 +318,41 @@ def test_straggler_finding_warn_only_surface():
     assert observe.straggler_finding({}, 1.0) is None      # no skew gauge
 
 
+def test_straggler_finding_carries_rebalance_context():
+    """A PR-16 sidecar names the straggler and the fleet size; the finding
+    must carry them plus the per-process share a restart_rebalanced
+    decision shrinks (1/processes — EpochLoader's uniform blocks)."""
+    from simclr_pytorch_distributed_tpu.supervise import observe
+
+    gauges = {
+        "train_boundary_skew_seconds": 1.5,
+        "train_step": 120.0,
+        "train_boundary_straggler": 1.0,
+        "train_process_count": 4.0,
+    }
+    finding = observe.straggler_finding(gauges, 1.0)
+    assert finding["straggler"] == 1
+    assert finding["processes"] == 4 and finding["share"] == 0.25
+
+
+def test_straggler_finding_identity_gauges_missing_or_single_process():
+    """Against an older sidecar (no identity gauges) the finding still
+    fires but carries no identity — enough to warn, not to mitigate; a
+    single-process fleet's -1 sentinel is likewise not an identity."""
+    from simclr_pytorch_distributed_tpu.supervise import observe
+
+    old = {"train_boundary_skew_seconds": 1.5, "train_step": 3.0}
+    finding = observe.straggler_finding(old, 1.0)
+    assert finding is not None
+    assert "straggler" not in finding and "processes" not in finding
+
+    single = dict(old, train_boundary_straggler=-1.0,
+                  train_process_count=1.0)
+    finding = observe.straggler_finding(single, 1.0)
+    assert "straggler" not in finding  # -1 = nobody was waited on
+    assert finding["processes"] == 1 and finding["share"] == 1.0
+
+
 def test_supervisor_records_straggler_finding_once_per_step(tmp_path):
     from simclr_pytorch_distributed_tpu.supervise import supervisor as sup
 

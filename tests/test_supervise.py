@@ -132,6 +132,247 @@ def test_policy_rejects_bad_config():
         policy.DecisionPolicy().request_resize(0)
 
 
+# ------------------------------------------------- the straggler ladder rows
+
+
+def sobs(rc=75, **kw):
+    """A mitigation-preempt exit: the supervisor gracefully preempted the
+    child after a persistence verdict naming host 1 of 2 at 150 ms skew."""
+    kw.setdefault("straggler_persistent", True)
+    kw.setdefault("straggler_host", 1)
+    kw.setdefault("straggler_skew_s", 0.15)
+    kw.setdefault("processes", 2)
+    return obs(rc, **kw)
+
+
+@pytest.mark.chaos
+def test_policy_extended_table_with_straggler_verdict():
+    """The FULL extended classification table: every exit-code row crossed
+    with a pending persistence verdict. Only the clean mitigation preempt
+    (75, not stalled) takes the ladder; every other row keeps its
+    pre-ladder decision — the verdict rides along as context, never as an
+    override."""
+    rows = {
+        0: policy.DONE,                    # completed: no mitigation needed
+        75: policy.RESTART_REBALANCED,     # the ladder's first rung
+        3: policy.GIVE_UP,                 # health abort still outranks all
+        1: policy.BACKOFF_RESTART,         # crash before the preempt landed
+        2: policy.BACKOFF_RESTART,
+        -9: policy.BACKOFF_RESTART,        # mitigation SIGTERM lapsed to KILL
+        -15: policy.BACKOFF_RESTART,
+        7: policy.BACKOFF_RESTART,
+    }
+    for rc, action in rows.items():
+        p = policy.DecisionPolicy(max_restarts=10)
+        assert p.decide(sobs(rc)).action == action, rc
+        # the ladder only advanced on the one row that took it
+        assert p.straggler_level == (1 if action == policy.RESTART_REBALANCED
+                                     else 0), rc
+
+
+@pytest.mark.chaos
+def test_policy_straggler_ladder_escalates_then_gives_up():
+    """Rung by rung: rebalance (share hint) -> exclude (topology minus the
+    slow host) -> give_up, with the budget charged per rung."""
+    p = policy.DecisionPolicy(max_restarts=10)
+    d1 = p.decide(sobs())
+    assert d1.action == policy.RESTART_REBALANCED
+    assert d1.share == "1:0.5" and d1.devices is None
+    assert "rebalancing" in d1.reason and d1.delay_s == 0.0
+    d2 = p.decide(sobs())
+    assert d2.action == policy.RESTART_RESIZED
+    assert d2.devices == 1 and d2.share is None   # 2 processes minus host 1
+    assert "excluding" in d2.reason
+    d3 = p.decide(sobs())
+    assert d3.action == policy.GIVE_UP and "ladder exhausted" in d3.reason
+    assert p.restarts == 2  # give_up never burned budget
+
+
+@pytest.mark.chaos
+def test_policy_straggler_unknown_fleet_size_excludes_without_topology():
+    """A verdict without a process count (older sidecar) still escalates,
+    but the exclusion rung cannot compute a topology — devices stays None
+    (inherit), the scheduler-level realization."""
+    p = policy.DecisionPolicy(max_restarts=10)
+    p.decide(sobs())
+    d = p.decide(sobs(processes=0))
+    assert d.action == policy.RESTART_RESIZED and d.devices is None
+
+
+@pytest.mark.chaos
+def test_policy_clean_preempt_without_verdict_resets_the_ladder():
+    """Recovery: a later clean preemption with NO verdict in force means
+    the rebalance worked — a straggler relapse starts the ladder at
+    rebalance again instead of escalating straight to exclusion."""
+    p = policy.DecisionPolicy(max_restarts=10)
+    assert p.decide(sobs()).action == policy.RESTART_REBALANCED
+    assert p.decide(obs(75)).action == policy.RESTART  # healthy preempt
+    assert p.straggler_level == 0
+    assert p.decide(sobs()).action == policy.RESTART_REBALANCED  # rung 1 again
+
+
+@pytest.mark.chaos
+def test_policy_pending_operator_resize_outranks_mitigation():
+    """Both landing on the same exit: the operator's explicit resize wins,
+    consumes the pending target, and the ladder does NOT advance — the
+    next verdict still starts at rebalance."""
+    p = policy.DecisionPolicy(max_restarts=10)
+    p.request_resize(4)
+    d = p.decide(sobs())
+    assert d.action == policy.RESTART_RESIZED and d.devices == 4
+    assert "explicit request wins" in d.reason
+    assert p.pending_resize is None and p.straggler_level == 0
+    assert p.decide(sobs()).action == policy.RESTART_REBALANCED
+
+
+@pytest.mark.chaos
+def test_policy_budget_caps_the_straggler_ladder():
+    """Mitigation restarts draw from the SAME budget as every other class
+    (the PREEMPT_RETRIES contract): an exhausted budget turns a verdict
+    into give_up before the ladder is consulted."""
+    p = policy.DecisionPolicy(max_restarts=1, backoff_base_s=0.1)
+    assert p.decide(obs(-9)).action == policy.BACKOFF_RESTART
+    d = p.decide(sobs())
+    assert d.action == policy.GIVE_UP and "budget" in d.reason
+    p0 = policy.DecisionPolicy(max_restarts=0)
+    assert p0.decide(sobs()).action == policy.GIVE_UP
+
+
+@pytest.mark.chaos
+def test_policy_stall_kill_outranks_straggler_verdict():
+    """A 75 forced by the supervisor's own STALL kill is a failure even
+    with a verdict pending: the stall row wins (backoff, no ladder) — a
+    wedged child must not be rewarded with a rebalance."""
+    p = policy.DecisionPolicy(max_restarts=10, backoff_base_s=1.0)
+    d = p.decide(sobs(stalled=True, stall_dumps=1))
+    assert d.action == policy.BACKOFF_RESTART and "stalled" in d.reason
+    assert p.straggler_level == 0
+
+
+# ------------------------------------------------------- the straggler tracker
+
+
+def skew_gauges(step, skew=0.2, straggler=1, processes=2):
+    g = {
+        "train_step": float(step),
+        observe.SKEW_GAUGE: float(skew),
+        observe.PROC_COUNT_GAUGE: float(processes),
+    }
+    if straggler is not None:
+        g[observe.STRAGGLER_GAUGE] = float(straggler)
+    return g
+
+
+@pytest.mark.chaos
+def test_tracker_k_of_n_verdict_and_consume():
+    t = observe.StragglerTracker(0.1, persist_k=3, window_n=5,
+                                 clock=lambda: 42.0)
+    for step in (1, 2):
+        f = t.observe(skew_gauges(step))
+        assert f is not None and f["straggler"] == 1
+        assert t.take_persistent() is None  # hysteresis: K not reached
+    t.observe(skew_gauges(3))
+    v = t.take_persistent()
+    assert v is not None
+    assert v["straggler"] == 1 and v["votes"] == 3 and v["window"] == 3
+    assert v["at"] == 42.0 and v["processes"] == 2 and v["share"] == 0.5
+    # consuming resets: detection starts fresh
+    assert t.take_persistent() is None
+    t.observe(skew_gauges(4))
+    assert t.take_persistent() is None
+
+
+@pytest.mark.chaos
+def test_tracker_scrapes_dedup_on_the_step_gauge():
+    """The skew gauge holds its value between flush boundaries, so many
+    scrapes of one boundary must count ONCE — per-poll counting would
+    convert one skewed boundary into an instant verdict."""
+    t = observe.StragglerTracker(0.1, persist_k=3, window_n=5)
+    assert t.observe(skew_gauges(7)) is not None
+    for _ in range(10):
+        assert t.observe(skew_gauges(7)) is None  # same boundary
+    assert t.take_persistent() is None
+    # a scrape with NO step gauge still dedups (None == None), not crash
+    g = skew_gauges(0)
+    del g["train_step"]
+    assert t.observe(dict(g)) is not None
+    assert t.observe(dict(g)) is None
+
+
+@pytest.mark.chaos
+def test_tracker_below_bar_boundaries_dilute_the_vote():
+    """Recovery hysteresis: below-bar boundaries enter the window as
+    non-votes, so a host that recovered walks itself back out instead of
+    being convicted on stale evidence."""
+    t = observe.StragglerTracker(0.1, persist_k=3, window_n=3)
+    t.observe(skew_gauges(1))
+    t.observe(skew_gauges(2))
+    # recovered: two clean boundaries push the spikes out of the window
+    t.observe(skew_gauges(3, skew=0.0))
+    t.observe(skew_gauges(4, skew=0.0))
+    t.observe(skew_gauges(5))
+    assert t.take_persistent() is None  # only 1 vote in the last 3
+
+
+@pytest.mark.chaos
+def test_tracker_identity_hop_never_convicts_anyone():
+    """Skew whose straggler identity hops between hosts is load imbalance,
+    not a sick host: no single host accumulates K votes (a 3-host
+    rotation caps any one host at 2 votes in a 5-boundary window)."""
+    t = observe.StragglerTracker(0.1, persist_k=3, window_n=5)
+    for step in range(1, 13):
+        t.observe(skew_gauges(step, straggler=step % 3, processes=3))
+        assert t.take_persistent() is None
+
+
+@pytest.mark.chaos
+def test_tracker_single_process_and_missing_identity_are_benign():
+    """No identity gauges (older sidecar) or a single-process fleet: the
+    finding may still fire (warn), but no vote is ever cast — there is no
+    host to rebalance away from."""
+    t = observe.StragglerTracker(0.1, persist_k=1, window_n=1)
+    assert t.observe(None) is None
+    assert t.observe({}) is None
+    # single process: identity -1, count 1 (what telemetry publishes)
+    f = t.observe(skew_gauges(1, straggler=-1, processes=1))
+    assert f is not None and "straggler" not in f
+    assert t.take_persistent() is None
+    # multi-process but the identity gauge is absent entirely
+    f2 = t.observe(skew_gauges(2, straggler=None))
+    assert f2 is not None and "straggler" not in f2
+    assert t.take_persistent() is None
+    # identity present but the fleet-size gauge says single: still benign
+    g = skew_gauges(3, straggler=0, processes=1)
+    assert t.observe(g) is not None
+    assert t.take_persistent() is None
+    # the disabled tracker (bar 0) observes nothing at all
+    t0 = observe.StragglerTracker(0.0, persist_k=1, window_n=1)
+    assert t0.observe(skew_gauges(1)) is None
+    assert t0.take_persistent() is None
+
+
+@pytest.mark.chaos
+def test_tracker_reset_clears_stale_votes():
+    """A new child attempt restarts its gauge stream: reset() must drop
+    accumulated votes AND the step dedup, or attempt 1's skew would
+    convict attempt 2 on its first boundary."""
+    t = observe.StragglerTracker(0.1, persist_k=3, window_n=5)
+    t.observe(skew_gauges(1))
+    t.observe(skew_gauges(2))
+    t.reset()
+    assert t.observe(skew_gauges(2)) is not None  # same step: dedup cleared
+    t.observe(skew_gauges(3))
+    assert t.take_persistent() is None  # old votes gone: only 2 of 3
+
+
+@pytest.mark.chaos
+def test_tracker_rejects_bad_config():
+    with pytest.raises(ValueError):
+        observe.StragglerTracker(1.0, persist_k=0)
+    with pytest.raises(ValueError):
+        observe.StragglerTracker(1.0, persist_k=3, window_n=2)
+
+
 # ----------------------------------------------------------------- observe
 
 
@@ -264,6 +505,22 @@ def test_build_command_appends_resume_last_wins():
     )
     assert cmd.index("stale") < cmd.index("/fresh")  # argparse last-wins
     assert launch.build_command(["x"], None) == ["x"]
+
+
+@pytest.mark.chaos
+def test_share_env_sets_and_clears_the_rebalance_hint():
+    base = {launch.FLEET_SHARE_ENV: "0:0.25", "OTHER": "x"}
+    env = launch.share_env("1:0.5", base)
+    assert env[launch.FLEET_SHARE_ENV] == "1:0.5" and env["OTHER"] == "x"
+    # None REMOVES a stale hint (post-exclusion/resize shares are uniform
+    # again) rather than inheriting it
+    assert launch.FLEET_SHARE_ENV not in launch.share_env(None, base)
+    assert base[launch.FLEET_SHARE_ENV] == "0:0.25"  # input not mutated
+    # composes with the topology rewrite (the Child launch env)
+    env2 = launch.share_env("1:0.5", launch.topology_env(4, {"A": "b"}))
+    assert env2[launch.FLEET_SHARE_ENV] == "1:0.5"
+    assert "--xla_force_host_platform_device_count=4" in env2["XLA_FLAGS"]
+    assert env2["A"] == "b"
 
 
 # ----------------------------------------------- the loop (scripted children)
@@ -410,6 +667,154 @@ sys.exit(0)
     assert resized["args"]["devices"] == 2
 
 
+@pytest.mark.chaos
+def test_loop_straggler_mitigation_drives_the_full_ladder(tmp_path):
+    """The LOOP end to end with a scripted fleet: a fake scraper keeps
+    reporting host 1 as the boundary straggler, and the supervisor must
+    walk the whole ladder — mitigation preempt -> restart_rebalanced with
+    the FLEET_SHARE_HINT actually in the relaunch's environment ->
+    (still slow) -> restart_resized excluding the host -> (still slow) ->
+    give_up, exiting with the child's clean 75.
+
+    The scraper serves gauges only once the CURRENT attempt's child has
+    installed its SIGTERM handler (it logs after installing), so the
+    graceful preempt is deterministic, not a boot race."""
+    import time as _time
+
+    log = tmp_path / "calls.log"
+    ws = tmp_path / "ws"
+    script = tmp_path / "fleet_stub.py"
+    script.write_text(f"""
+import json, os, signal, sys, time
+signal.signal(signal.SIGTERM, lambda *a: sys.exit(75))
+run_dir = os.path.join({str(ws)!r}, "synthetic_models", "synthetic_0101_0000_run")
+ckpt = os.path.join(run_dir, "preempt_epoch_1_step_2")
+os.makedirs(ckpt, exist_ok=True)
+with open(os.path.join(ckpt, "meta.json"), "w") as f:
+    f.write('{{"epoch": 1, "step_in_epoch": 2}}')
+with open({str(log)!r}, "a") as f:
+    f.write(json.dumps({{
+        "share": os.environ.get("FLEET_SHARE_HINT", ""),
+        "xla": os.environ.get("XLA_FLAGS", ""),
+    }}) + "\\n")
+time.sleep(60)
+sys.exit(0)
+""")
+
+    class SkewScraper:
+        """train_boundary_* gauges naming host 1, a fresh boundary per
+        scrape — but only while the newest child is ready (handler
+        installed == its log line written)."""
+
+        sup = None
+
+        def __init__(self):
+            self.step = 0
+
+        def scrape(self):
+            try:
+                with open(log) as f:
+                    ready = sum(1 for _ in f)
+            except OSError:
+                ready = 0
+            if self.sup is None or ready <= len(self.sup.decisions):
+                return None  # current attempt's handler not installed yet
+            self.step += 1
+            return {
+                "train_step": float(self.step),
+                observe.SKEW_GAUGE: 0.2,
+                observe.STRAGGLER_GAUGE: 1.0,
+                observe.PROC_COUNT_GAUGE: 2.0,
+            }
+
+    cfg = SuperviseConfig(
+        command=[sys.executable, str(script)], workdir=str(ws),
+        max_restarts=10, poll_s=0.05, grace_secs=20.0,
+        straggler_skew_secs=0.1, straggler_persist_k=3,
+        straggler_window_n=5, straggler_mitigate=True,
+    )
+    scraper = SkewScraper()
+    sup = Supervisor(cfg, scraper=scraper)
+    scraper.sup = sup
+    box = {}
+    t = threading.Thread(target=lambda: box.update(rc=sup.run()), daemon=True)
+    t.start()
+    t.join(timeout=120)
+    assert not t.is_alive(), "mitigation ladder never completed"
+    assert box["rc"] == 75  # give_up reports the final clean preempt code
+    assert [d.action for d in sup.decisions] == [
+        policy.RESTART_REBALANCED, policy.RESTART_RESIZED, policy.GIVE_UP,
+    ]
+    assert sup.decisions[0].share == "1:0.5"
+    assert sup.decisions[1].devices == 1  # 2 processes minus the slow host
+
+    calls = [json.loads(line) for line in open(log)]
+    assert len(calls) == 3
+    # the rebalance hint reached ONLY the rebalanced relaunch's environment
+    assert [c["share"] for c in calls] == ["", "1:0.5", ""]
+    # ...and the exclusion rung carried the shrunk topology
+    assert "--xla_force_host_platform_device_count=1" in calls[2]["xla"]
+
+    events = read_events(sup)
+    names = [e["name"] for e in events]
+    assert names.count("straggler_persistent") == 3
+    mitigation = [e["args"] for e in events
+                  if e["name"] == "straggler_mitigation"]
+    assert [m["phase"] for m in mitigation] == [
+        "preempt", "decided", "preempt", "decided", "preempt", "decided",
+    ]
+    assert [m.get("action") for m in mitigation if m["phase"] == "decided"] \
+        == ["restart_rebalanced", "restart_resized", "give_up"]
+    launches = [e["args"] for e in events if e["name"] == "launch"]
+    assert [la.get("share") for la in launches] == [None, "1:0.5", None]
+    # every relaunch resumed from the preempt save
+    assert all(la["resume"] for la in launches[1:])
+
+
+@pytest.mark.chaos
+def test_loop_warn_only_records_verdicts_without_acting(tmp_path):
+    """straggler_mitigate=False (the default): verdicts land on the
+    recorder as straggler_persistent events, but the child is never
+    preempted — the run completes and the decision log shows only DONE."""
+    ws = tmp_path / "ws"
+    script = tmp_path / "warn_stub.py"
+    # lives long enough to be scraped a few times, then completes cleanly
+    script.write_text(f"""
+import os, sys, time
+os.makedirs(os.path.join({str(ws)!r}, "synthetic_models", "r1"), exist_ok=True)
+time.sleep(1.5)
+sys.exit(0)
+""")
+
+    class OneShotSkew:
+        def __init__(self):
+            self.step = 0
+
+        def scrape(self):
+            self.step += 1
+            return {
+                "train_step": float(self.step),
+                observe.SKEW_GAUGE: 0.2,
+                observe.STRAGGLER_GAUGE: 1.0,
+                observe.PROC_COUNT_GAUGE: 2.0,
+            }
+
+    cfg = SuperviseConfig(
+        command=[sys.executable, str(script)], workdir=str(ws),
+        max_restarts=3, poll_s=0.02, straggler_skew_secs=0.1,
+        straggler_persist_k=1, straggler_window_n=1,
+    )
+    sup = Supervisor(cfg, scraper=OneShotSkew())
+    rc = sup.run()
+    assert rc == 0
+    assert [d.action for d in sup.decisions] == [policy.DONE]
+    events = read_events(sup)
+    verdicts = [e["args"] for e in events
+                if e["name"] == "straggler_persistent"]
+    assert verdicts and all(v["mitigate"] is False for v in verdicts)
+    assert not [e for e in events if e["name"] == "straggler_mitigation"]
+
+
 # ------------------------------------------- committed evidence + ratchet gate
 
 
@@ -476,6 +881,102 @@ def test_committed_evidence_artifact_passes_the_gate():
     with open(path) as f:
         artifact = json.load(f)
     r = _gate().supervisor_gate_record(artifact)
+    assert r["ok"], r
+
+
+def sample_chaos_artifact():
+    return {
+        "metric": "chaos_matrix",
+        "schema": "chaos_matrix/v1",
+        "scenarios": {
+            "straggler": {
+                "ok": True, "rc": 0,
+                "decisions": ["restart_rebalanced", "done"],
+                "straggler_findings": 4, "persistence_verdicts": 1,
+                "mitigation_events": 2,
+                "launch_shares": [None, "1:0.5"],
+                "share_hint_carried": "1:0.5",
+                "digests": [12.5, 12.5], "control_digests": [12.5, 12.5],
+                "bit_identical": True,
+            },
+            "chaos": {
+                "ok": True, "rc": 0,
+                "decisions": ["restart_rebalanced", "backoff_restart",
+                              "done"],
+                "mitigation_events": 2, "killed_pid": 4242,
+                "health_alarms_observed": 6,
+            },
+        },
+        "ok": True,
+    }
+
+
+@pytest.mark.chaos
+def test_chaos_gate_record_accepts_complete_artifact():
+    r = _gate().chaos_gate_record(sample_chaos_artifact())
+    assert r["ok"], r
+    assert r["metric"] == "ratchet_chaos_matrix"
+    assert sorted(r["scenarios"]) == ["chaos", "straggler"]
+
+
+@pytest.mark.chaos
+def test_chaos_gate_record_rejects_weakened_evidence():
+    """Each load-bearing claim, individually removed, must fail the gate —
+    a hand-edited artifact cannot sneak past on decision strings alone."""
+    gate = _gate()
+    art = sample_chaos_artifact()
+    art["schema"] = "chaos_matrix/v0"
+    assert not gate.chaos_gate_record(art)["ok"]
+
+    art = sample_chaos_artifact()
+    del art["scenarios"]["chaos"]
+    r = gate.chaos_gate_record(art)
+    assert not r["ok"] and "chaos" in r["error"]
+
+    art = sample_chaos_artifact()
+    art["scenarios"]["straggler"]["decisions"] = ["backoff_restart", "done"]
+    assert not gate.chaos_gate_record(art)["ok"]
+
+    art = sample_chaos_artifact()
+    art["scenarios"]["straggler"]["rc"] = 75
+    assert not gate.chaos_gate_record(art)["ok"]
+
+    # mitigation must have BOTH phases on record (preempt + decided)
+    art = sample_chaos_artifact()
+    art["scenarios"]["chaos"]["mitigation_events"] = 1
+    assert not gate.chaos_gate_record(art)["ok"]
+
+    # the share hint must have actually reached a relaunch
+    art = sample_chaos_artifact()
+    art["scenarios"]["straggler"]["launch_shares"] = [None, None]
+    r = gate.chaos_gate_record(art)
+    assert not r["ok"] and "share" in r["error"]
+
+    # digest divergence from the policy-off control is disqualifying
+    art = sample_chaos_artifact()
+    art["scenarios"]["straggler"]["bit_identical"] = False
+    r = gate.chaos_gate_record(art)
+    assert not r["ok"] and "control" in r["error"]
+
+    art = sample_chaos_artifact()
+    art["scenarios"]["chaos"]["health_alarms_observed"] = 0
+    assert not gate.chaos_gate_record(art)["ok"]
+
+    art = sample_chaos_artifact()
+    art["scenarios"]["chaos"]["killed_pid"] = 0
+    assert not gate.chaos_gate_record(art)["ok"]
+
+
+@pytest.mark.chaos
+def test_committed_chaos_evidence_passes_the_gate():
+    """docs/evidence/chaos_matrix_r16.json — produced by
+    scripts/supervisor_matrix.py --scenarios straggler chaos driving the
+    REAL supervisor over the real gloo fleet — must satisfy the same pure
+    gate ratchet runs."""
+    path = os.path.join(REPO, "docs", "evidence", "chaos_matrix_r16.json")
+    with open(path) as f:
+        artifact = json.load(f)
+    r = _gate().chaos_gate_record(artifact)
     assert r["ok"], r
 
 
