@@ -232,6 +232,22 @@ CONFIGS = {
     "chaos_matrix": dict(model=None, epochs=0, bar=None, kind="chaos_gate",
                          dataset=None,
                          artifact="docs/evidence/chaos_matrix_r16.json"),
+    # round 17: the serve-fleet gate. Binds on the COMMITTED evidence
+    # artifact (docs/evidence/serve_fleet_r17.json, produced by
+    # scripts/serve_fleet_scenario.py driving a REAL supervised replica
+    # fleet — two `python -m ...serve.fleet` subprocesses under
+    # supervise/replica_fleet.py): the pure serve_fleet_gate_record
+    # re-verifies that the supervisor raised the fleet to its floor off
+    # scraped /metrics, a SIGKILLed replica was restarted on the SAME
+    # port within the budget and served again, a /models/promote hot-swap
+    # landed under live /embed load with ZERO failed requests (old
+    # version retired, new serving), and /neighbors answered a served
+    # image with itself at cosine ~1.0. Re-produce the artifact with the
+    # scenario script when the fleet/registry surface changes; instant,
+    # so it rides the default list.
+    "serve_fleet": dict(model=None, epochs=0, bar=None,
+                        kind="serve_fleet_gate", dataset=None,
+                        artifact="docs/evidence/serve_fleet_r17.json"),
 }
 
 # CPU-calibrated bar for the health_report smoke's online probe: best
@@ -742,6 +758,80 @@ def chaos_gate_record(artifact):
     return record
 
 
+def serve_fleet_gate_record(artifact):
+    """Gate decision for the serve-fleet scenario evidence (pure — tested
+    without spawning a fleet).
+
+    Binds everywhere, hardware-independently (the supervisor_gate
+    convention): the claims are decision records, HTTP outcomes, and a
+    cosine identity — not timings. Checks: the supervisor spawned the
+    fleet to its 2-replica floor and both replicas answered /embed; a
+    SIGKILLed replica produced a ``restart_replica`` decision back onto
+    the SAME port (old returncode -9) and served again; the
+    /models/promote hot-swap landed under live load with ZERO failed
+    requests while the old version retired and version 2 took over; the
+    /neighbors top-1 for a served image is the image itself at cosine
+    ~1.0; and no replica slot was given up.
+    """
+    phases = artifact.get("phases", {})
+    record = {
+        "metric": "ratchet_serve_fleet",
+        "value": len(phases),
+        "phases": sorted(phases),
+    }
+
+    def fail(msg):
+        record["ok"] = False
+        record["error"] = msg
+        return record
+
+    if artifact.get("schema") != "serve_fleet/v1":
+        return fail(f"unexpected schema {artifact.get('schema')!r}")
+    for name in ("spawn", "restart", "promote", "neighbors"):
+        rec = phases.get(name)
+        if rec is None:
+            return fail(f"phase {name!r} missing from the fleet artifact")
+        if not rec.get("ok"):
+            return fail(f"phase {name!r} not ok in the fleet artifact")
+    spawn = phases["spawn"]
+    if len(spawn.get("replicas", {})) < 2:
+        return fail("spawn phase never reached the 2-replica floor")
+    if len(spawn.get("warm_embed", {})) < 2:
+        return fail("spawn phase lacks /embed proof from both replicas")
+    restart = phases["restart"]
+    restarts = [d for d in restart.get("decisions", [])
+                if d.get("action") == "restart_replica"]
+    if not restarts:
+        return fail("restart phase recorded no restart_replica decision")
+    if restarts[0].get("port") != restart.get("port"):
+        return fail("restart did not relaunch on the same port")
+    if restarts[0].get("old_returncode") != -9:
+        return fail(f"restarted replica's returncode "
+                    f"{restarts[0].get('old_returncode')} is not SIGKILL")
+    if not restart.get("served_after_restart"):
+        return fail("restarted replica never served again")
+    promote = phases["promote"]
+    if promote.get("embed_failures"):
+        return fail(f"hot-swap dropped requests: "
+                    f"{promote['embed_failures']}")
+    if promote.get("embed_ok", 0) < 10:
+        return fail("promote phase had no meaningful live load")
+    if not promote.get("drained"):
+        return fail("old version never drained to 'retired'")
+    if promote.get("response", {}).get("version") != 2:
+        return fail("promote did not install version 2")
+    neighbors = phases["neighbors"]
+    if not neighbors.get("self_top1"):
+        return fail("served image is not its own /neighbors top-1")
+    if neighbors.get("top1_score", 0.0) < 0.999:
+        return fail(f"self-neighbor cosine {neighbors.get('top1_score')} "
+                    "below identity")
+    if artifact.get("gave_up"):
+        return fail(f"supervisor gave up on replicas {artifact['gave_up']}")
+    record["ok"] = True
+    return record
+
+
 def fleet_gate_record(artifact):
     """Gate decision for the fleet-merge evidence artifact (pure — tested
     without running a pod).
@@ -1247,6 +1337,24 @@ def run_config(name, spec, epochs, bar, args):
         print(json.dumps(record), flush=True)
         return record
 
+    if kind == "serve_fleet_gate":
+        # binds on the COMMITTED serve-fleet scenario evidence (see the
+        # CONFIGS note): no subprocess — re-run
+        # scripts/serve_fleet_scenario.py when the fleet surface changes
+        path = os.path.join(REPO, spec["artifact"])
+        try:
+            with open(path) as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ConfigFailed(
+                f"no readable serve-fleet evidence at {path}: {e}"
+            ) from e
+        record = serve_fleet_gate_record(artifact)
+        record["bar"] = bar
+        record["artifact"] = spec["artifact"]
+        print(json.dumps(record), flush=True)
+        return record
+
     if kind == "ce":
         # the CE trainer end-to-end: train + validate in one driver
         # (protocol of docs/evidence/ce_30ep.log: rn50, lr 0.1 cosine, bf16)
@@ -1352,6 +1460,8 @@ def main():
                 metric = "ratchet_supervisor_matrix"
             elif spec["kind"] == "chaos_gate":
                 metric = "ratchet_chaos_matrix"
+            elif spec["kind"] == "serve_fleet_gate":
+                metric = "ratchet_serve_fleet"
             elif spec["kind"] == "fleet_report":
                 metric = "ratchet_fleet_report"
             elif spec["kind"] == "perf_ledger":

@@ -25,6 +25,13 @@ and size-7 requests share the bucket-8 program and the same latency
 population). Results go to a JSON artifact (``--json``, default
 ``docs/evidence/serve_bench_smoke.json`` in smoke mode).
 
+``--sweep`` additionally runs a **mixed-tenant multi-model arm**: two
+checkpoint versions hosted behind one ``ModelRegistry`` (serve/fleet/),
+driven by a skewed tenant mix (a bulk tenant hammering the default model,
+an interactive tenant on the canary) — per-model throughput/latency plus
+the admission-controller counters land in the artifact under
+``multi_model``.
+
 ``--smoke`` is the CI end-to-end proof (tests/test_scripts.py): tiny
 random-init model on CPU, a short closed + open loop through the REAL
 DynamicBatcher, a duplicate-image pass through the REAL cache, and one
@@ -346,6 +353,104 @@ def paired_saturation_sweep(engine, args):
     return {name: _arm_summary(arm, args) for name, arm in arms.items()}
 
 
+def multi_model_arm(args, rng, sizes):
+    """Mixed-tenant multi-model arm: two versions of the model hosted
+    behind one ModelRegistry, a skewed tenant mix (bulk tenant -> default
+    model ~3:1 over interactive tenant -> canary), every request routed
+    through registry.submit's admission + per-model batchers. Reports
+    per-model latency/throughput and the admission counters — the fleet
+    analogue of the single-model arms.
+
+    The arm is CLOSED-LOOP with one request outstanding across the whole
+    registry: on a multi-device mesh, two engines' compiled programs run
+    concurrently under the pipelined path, and XLA's collective rendezvous
+    deadlocks when different executables' collectives (the CPU backend
+    consolidates sharded outputs through a compiled AllGather) interleave
+    across device threads — the cross-MODEL analogue of the training-side
+    collective-schedule contract. One program in flight at a time is the
+    safe schedule; production hosts one model per replica (the
+    serve_fleet_scenario geometry), where the hazard does not arise."""
+    from simclr_pytorch_distributed_tpu.serve.fleet import (
+        AdmissionController,
+        ModelRegistry,
+    )
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    engine_kwargs = dict(
+        buckets=buckets, img_size=args.img_size, dtype=args.dtype
+    )
+    registry = ModelRegistry(
+        batcher_kwargs=dict(
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            max_queue=args.max_queue, max_inflight=args.max_inflight,
+            max_inflight_images=args.max_inflight_images,
+        ),
+        admission=AdmissionController(max_tenant_rows=0),
+        index_capacity=0,
+    )
+    try:
+        for name, seed in (("prod", args.seed), ("canary", args.seed + 1)):
+            engine = EmbeddingEngine.random_init(
+                model_name=args.model, size=args.img_size, seed=seed,
+                **engine_kwargs,
+            )
+            # warm outside the timed loop, like the single-model arms
+            for b in buckets:
+                engine.embed(make_images(rng, b, args.img_size))
+            registry.add_model(name, engine)
+
+        plan = []
+        for _ in range(args.sweep_requests):
+            if rng.random() < 0.75:
+                plan.append(("prod", "bulk"))
+            else:
+                plan.append(("canary", "interactive"))
+        records = {"prod": [], "canary": []}
+        images_by_model = {"prod": 0, "canary": 0}
+        shed = 0
+        t_start = time.perf_counter()
+        done = 0
+        for model, tenant in plan:
+            n = int(rng.choice(sizes))
+            images = make_images(rng, n, args.img_size)
+            t0 = time.perf_counter()
+            try:
+                name, fut = registry.submit(images, model=model, tenant=tenant)
+            except QueueFull:
+                shed += 1
+                continue
+            # closed-loop: wait before the next submit so at most one
+            # compiled program is ever in flight across the two engines
+            # (see the docstring's collective-schedule note)
+            fut.result(timeout=120)
+            records[name].append((time.perf_counter() - t0) * 1e3)
+            images_by_model[name] += n
+            done += 1
+        elapsed = time.perf_counter() - t_start
+        stats = registry.stats()
+        return {
+            "tenancy": {"bulk": "prod", "interactive": "canary"},
+            "requests": done,
+            "shed_by_backpressure": shed,
+            "elapsed_s": round(elapsed, 3),
+            "throughput_imgs_per_s": round(
+                sum(images_by_model.values()) / elapsed, 2
+            ),
+            "per_model": {
+                name: {
+                    "requests": len(lat),
+                    "images": images_by_model[name],
+                    "latency": percentiles(lat),
+                    "errors": stats["models"][name]["batcher"]["errors"],
+                }
+                for name, lat in records.items()
+            },
+            "admission": stats["admission"],
+        }
+    finally:
+        registry.close()
+
+
 def cache_pass(batcher, engine, rng, size):
     """Submit the SAME images twice; the second pass must be answered from
     the cache (hits recorded, no new engine dispatches)."""
@@ -499,6 +604,7 @@ def main(argv=None):
                 else None
             ),
             "http": http_result,
+            "multi_model": multi_model_arm(args, rng, sizes),
             "engine_stats": engine.stats(),
             "device": str(engine.mesh.devices.flat[0].device_kind),
         }

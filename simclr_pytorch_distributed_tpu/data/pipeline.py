@@ -11,7 +11,16 @@ reshuffles per epoch via ``sampler.set_epoch(epoch)`` (``main_supcon.py:195-199,
 - ``drop_last`` truncation to whole GLOBAL batches (``main_supcon.py:206``);
 - each process slices its contiguous block of every global batch
   (``process_index * per_proc : ... + per_proc``) — the multi-host analogue of
-  per-rank ``batch_size // ngpu`` (``main_supcon.py:202``);
+  per-rank ``batch_size // ngpu`` (``main_supcon.py:202``). The block
+  boundaries come from :func:`share_splits`, which honors the supervisor's
+  ``FLEET_SHARE_HINT`` (``host:factor``): a straggling host hands part of its
+  uniform share to its peers, while the UNION of all process slices stays
+  exactly the global batch the epoch permutation defined — global batch
+  composition is share-invariant. NOTE: the pjit trainers do not opt in —
+  ``shard_host_batch`` (parallel/mesh.py) requires uniform per-process shapes
+  via ``make_array_from_process_local_data`` — so uneven shares serve
+  host-side consumers (data-echo staging, eval sweeps, serving warm-up) until
+  the device path learns ragged shards;
 - batch assembly (uint8 row gather) runs through the native C++ library
   (``native/gather.cpp``) when available — it releases the GIL, so the
   ``prefetch`` background thread genuinely overlaps staging of batch k+1 with
@@ -24,11 +33,78 @@ from __future__ import annotations
 import ctypes
 import queue
 import threading
-from typing import Iterator, Tuple
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from simclr_pytorch_distributed_tpu.native.build import load as load_native
+
+# The canonical name of the supervisor's share-rebalance env hint. Defined
+# HERE (the consumer, jax-free) and imported by supervise/launch.py (the
+# producer), so the contract has exactly one spelling.
+FLEET_SHARE_ENV = "FLEET_SHARE_HINT"
+
+
+def parse_share_hint(hint: Optional[str]) -> Optional[Tuple[int, float]]:
+    """Parse a ``"host:factor"`` share hint; None for anything malformed.
+
+    Malformed hints are IGNORED, not raised: the hint is advisory operator
+    input that crosses a process boundary via the environment, and a typo
+    must degrade to the uniform split rather than kill a relaunch the
+    supervisor just decided was worth making.
+    """
+    if not hint:
+        return None
+    try:
+        host_s, factor_s = str(hint).split(":", 1)
+        host, factor = int(host_s), float(factor_s)
+    except ValueError:
+        return None
+    if host < 0 or not (0.0 < factor <= 1.0) or factor != factor:
+        return None
+    return host, factor
+
+
+def share_splits(
+    global_batch_size: int,
+    process_count: int,
+    hint: Optional[str] = None,
+) -> List[Tuple[int, int]]:
+    """Per-process ``[lo, hi)`` bounds into each global batch.
+
+    Uniform (``per_proc = gbs // P``) unless ``hint`` names a valid process
+    and factor, in which case that process keeps ``round(per_proc * factor)``
+    rows (floored at 1 — every process must contribute, or collectives that
+    count participants by rows would wedge) and the deficit spreads evenly
+    over the other processes (remainder to the lowest indices, so the split
+    is deterministic). Invariants, pinned by tests/test_data.py: bounds are
+    contiguous, start at 0, end at ``global_batch_size`` — the union of all
+    slices is the whole global batch, whatever the hint says.
+    """
+    per_proc = global_batch_size // process_count
+    sizes = [per_proc] * process_count
+    parsed = parse_share_hint(hint)
+    if parsed is not None and process_count > 1:
+        host, factor = parsed
+        if host < process_count:
+            keep = max(1, int(round(per_proc * factor)))
+            deficit = per_proc - keep
+            if deficit > 0:
+                sizes[host] = keep
+                others = process_count - 1
+                bump, rem = divmod(deficit, others)
+                j = 0
+                for i in range(process_count):
+                    if i == host:
+                        continue
+                    sizes[i] += bump + (1 if j < rem else 0)
+                    j += 1
+    bounds = []
+    lo = 0
+    for size in sizes:
+        bounds.append((lo, lo + size))
+        lo += size
+    return bounds
 
 
 def _gather(images: np.ndarray, labels: np.ndarray, sel: np.ndarray):
@@ -71,6 +147,7 @@ class EpochLoader:
         process_index: int = 0,
         process_count: int = 1,
         prefetch: int = 2,
+        share_hint: Optional[str] = None,
     ):
         if global_batch_size % process_count != 0:
             raise ValueError(
@@ -86,6 +163,13 @@ class EpochLoader:
         self.process_index = process_index
         self.process_count = process_count
         self.prefetch = prefetch
+        # this process's [lo, hi) window into every global batch; uniform
+        # unless a FLEET_SHARE_HINT rebalances it (module docstring)
+        self.share_hint = share_hint
+        self.share_bounds = share_splits(
+            global_batch_size, process_count, share_hint
+        )
+        self._lo, self._hi = self.share_bounds[process_index]
         n = len(images)
         if drop_last:
             self.steps_per_epoch = n // global_batch_size
@@ -124,11 +208,9 @@ class EpochLoader:
         self, epoch: int, start_step: int = 0
     ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         order = self._epoch_order(epoch)
-        per_proc = self.global_batch_size // self.process_count
-        lo = self.process_index * per_proc
         for step in range(start_step, self.steps_per_epoch):
             sel = order[step * self.global_batch_size:(step + 1) * self.global_batch_size]
-            sel = sel[lo:lo + per_proc]
+            sel = sel[self._lo:self._hi]
             yield _gather(self.images, self.labels, sel)
 
     def epoch(

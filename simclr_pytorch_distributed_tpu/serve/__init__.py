@@ -12,9 +12,15 @@ Turns any checkpoint this repo produces (or imports from the reference
   pipelined (up to ``max_inflight`` batches on device while the assembler
   keeps dispatching), with bounded-queue backpressure (``QueueFull``) and
   per-request timeouts;
-- :mod:`cache` — ``EmbeddingCache``: content-keyed LRU over computed rows;
+- :mod:`cache` — ``EmbeddingCache``: content-keyed LRU over computed rows
+  (keys carry model identity + weights, so shared caches survive hot-swaps);
 - :mod:`server` — stdlib ``http.server`` JSON endpoint
-  (``/embed``, ``/healthz``, ``/stats``) — no new runtime dependency.
+  (``/embed``, ``/healthz``, ``/stats``) — no new runtime dependency;
+- :mod:`fleet` — the multi-model layer: ``ModelRegistry`` hosting N named
+  checkpoint versions with hot-swap promotion (in-flight work drains on the
+  old engine), per-tenant admission control, a ``/neighbors`` retrieval
+  index over served embeddings, and the fleet HTTP frontend the
+  replica-fleet supervisor (supervise/) manages.
 
 See ``docs/SERVING.md`` for the API contract and bench methodology
 (``scripts/serve_bench.py``).

@@ -178,6 +178,15 @@ class DynamicBatcher:
         self._occ_last = self._occ_start
         self._occ_area = 0.0  # ∫ inflight_depth dt
         self._occ_busy = 0.0  # time with >= 1 batch in flight
+        # liveness anchor: when the pipeline last delivered a completion
+        # (success OR failure — both are forward progress; a replica that
+        # only ever errors is unhealthy on `errors`, not on liveness).
+        # Initialized to construction time so `last_completion_age_s` reads
+        # "seconds since the batcher last proved it can finish work" from
+        # the very first scrape — the replica-fleet supervisor's stall
+        # signal (supervise/replica.py), meaningful only alongside
+        # queue_depth/inflight_batches > 0 (an idle server ages too).
+        self._last_completion = self._occ_start
         self._stats = {
             "submitted": 0,
             "rejected": 0,
@@ -312,6 +321,11 @@ class DynamicBatcher:
             )
             s["avg_inflight_depth"] = (
                 self._occ_area / elapsed if elapsed > 0 else 0.0
+            )
+            # numeric leaf -> auto-exported as serve_batcher_last_completion_
+            # age_s by serve_metrics_fn: the replica-fleet liveness gauge
+            s["last_completion_age_s"] = max(
+                0.0, self._occ_last - self._last_completion
             )
         s["max_batch"] = self._max_batch
         s["max_wait_ms"] = self._max_wait_s * 1e3
@@ -450,10 +464,12 @@ class DynamicBatcher:
         except Exception as exc:  # noqa: BLE001 — delivered to every waiter
             with self._cond:
                 self._stats["errors"] += 1
+                self._last_completion = self._clock()
             for req in inflight.batch:
                 self._fail(req, exc)
             return
         with self._cond:
+            self._last_completion = self._clock()
             self._stats["batches"] += 1
             self._stats["batched_images"] += inflight.total
             self._stats["max_batch_observed"] = max(

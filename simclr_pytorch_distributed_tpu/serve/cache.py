@@ -9,6 +9,13 @@ image bytes (plus the engine's preprocessing fingerprint, see
 ``EmbeddingEngine._cache_key``), so two byte-identical images always share an
 entry regardless of which request they arrived in.
 
+The key must carry MODEL IDENTITY, not just content: the engine's
+fingerprint prefix includes the served ``identity`` (``"<name>@v<version>"``,
+stamped by the fleet registry at promote time) on top of the weights probe,
+so a hot-swap promotion can never serve a stale hit computed by the retired
+version — even when the new checkpoint's weights are byte-identical
+(``EmbeddingEngine.set_identity``; pinned by tests/test_serve_fleet.py).
+
 Thread-safe: the batcher worker writes while HTTP stats readers poll
 counters. Stored rows are frozen (``writeable=False``) so a caller mutating a
 returned row cannot poison later hits.

@@ -160,6 +160,7 @@ class EmbeddingEngine:
         img_size: int = 32,
         cache=None,
         dtype: str = "fp32",
+        identity: str = "",
     ):
         if output not in ("features", "projection"):
             raise ValueError(f"output must be features|projection, got {output!r}")
@@ -227,11 +228,27 @@ class EmbeddingEngine:
         probe = hashlib.sha1()
         for leaf in jax.tree.leaves(variables):
             probe.update(np.asarray(leaf).tobytes())
-        weights_probe = probe.hexdigest()[:16]
+        self._weights_probe = probe.hexdigest()[:16]
+        self.identity = ""
+        self.set_identity(identity)
+
+    def set_identity(self, identity: str) -> None:
+        """Stamp the engine's served identity (``"<model name>@v<version>"``)
+        into its cache-key fingerprint.
+
+        The weights probe already separates engines whose *weights* differ,
+        but a hot-swap promotion must invalidate cached rows even when the
+        new version's weights happen to be byte-identical (a re-exported or
+        rolled-back checkpoint): after ``POST /models/promote`` every hit
+        must come from the version that is actually serving. The registry
+        (serve/fleet/registry.py) stamps ``name@vN`` BEFORE the version
+        becomes visible to traffic — this is not safe to call with requests
+        in flight (``_cache_key`` reads the prefix without a lock)."""
+        self.identity = str(identity)
         self._key_prefix = (
-            f"{model.model_name}|{weights_probe}|{self.output}|"
-            f"{int(self.normalize)}|{self.dtype}|{self._aug_cfg.mean}|"
-            f"{self._aug_cfg.std}|".encode()
+            f"{self.identity}|{self.model.model_name}|{self._weights_probe}|"
+            f"{self.output}|{int(self.normalize)}|{self.dtype}|"
+            f"{self._aug_cfg.mean}|{self._aug_cfg.std}|".encode()
         )
 
     # ------------------------------------------------------------ loading
@@ -445,6 +462,7 @@ class EmbeddingEngine:
                 "traces": dict(self._stats["traces"]),
             }
         s["model"] = self.model.model_name
+        s["identity"] = self.identity
         s["output"] = self.output
         s["normalize"] = self.normalize
         s["dtype"] = self.dtype

@@ -1,0 +1,39 @@
+"""serve/fleet/ — multi-model hosting, hot-swap promotion, retrieval.
+
+The single-model stack (engine -> batcher -> server) scales one checkpoint;
+a production fleet serves MANY — several model versions live at once, new
+checkpoints promoted under load, and the service answers similarity
+queries, not just embeddings. This package composes the existing pieces
+into that:
+
+- :mod:`registry` — :class:`ModelRegistry`: N named checkpoint versions
+  behind one server; per-model batchers whose queues survive promotes;
+  hot-swap ``promote()`` draining in-flight work on the old engine through
+  the dispatch/completion split (zero failed requests across a swap);
+  per-(model, tenant) :class:`AdmissionController` quotas over the
+  batcher's QueueFull backpressure; per-version cache identity
+  (``EmbeddingEngine.set_identity``) so a shared cache never serves a
+  retired version's rows;
+- :mod:`retrieval` — :class:`NeighborIndex`: bounded, content-keyed,
+  LRU-evicted store of served embeddings with an on-device brute-force
+  cosine scorer — the ``/neighbors`` endpoint's substrate;
+- :mod:`frontend` — the HTTP surface: ``/embed`` with model routing,
+  ``/models/promote``, ``/neighbors``, ``/models``, and a ``/metrics``
+  exposition whose unlabeled gauges the replica-fleet supervisor
+  (supervise/replica_fleet.py) scrapes. ``python -m
+  simclr_pytorch_distributed_tpu.serve.fleet`` serves it.
+
+Evidence: the end-to-end multi-process scenario (spawn -> saturate ->
+restart a killed replica -> promote under load -> drain) is
+``scripts/serve_fleet_scenario.py``, committed as
+``docs/evidence/serve_fleet_r17.json`` and gated by ``scripts/ratchet.py``.
+"""
+
+from simclr_pytorch_distributed_tpu.serve.fleet.registry import (  # noqa: F401
+    AdmissionController,
+    ModelRegistry,
+    ModelVersion,
+)
+from simclr_pytorch_distributed_tpu.serve.fleet.retrieval import (  # noqa: F401
+    NeighborIndex,
+)

@@ -47,7 +47,11 @@ logger = logging.getLogger(__name__)
 MAX_BODY_BYTES = 64 * 1024 * 1024  # one request can't OOM the server
 
 
-def _decode_images(payload: dict) -> np.ndarray:
+def decode_images(payload: dict) -> np.ndarray:
+    """Images from a request body: ``"images"`` (nested uint8 lists) or
+    ``"images_b64"`` + ``"shape"`` (base64 raw bytes). Shared with the
+    multi-model frontend (serve/fleet/frontend.py) so both servers accept
+    byte-identical payloads."""
     if "images_b64" in payload:
         shape = payload.get("shape")
         if not isinstance(shape, (list, tuple)) or len(shape) != 4:
@@ -134,7 +138,7 @@ def make_handler(
                 return
             try:
                 payload = json.loads(self.rfile.read(length))
-                images = _decode_images(payload)
+                images = decode_images(payload)
                 timeout_ms = payload.get("timeout_ms")
                 if timeout_ms is not None and (
                     not isinstance(timeout_ms, (int, float))

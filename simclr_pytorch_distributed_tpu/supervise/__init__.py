@@ -27,7 +27,13 @@ Layout (one concern per module, the utils/ convention):
 - :mod:`supervisor` — the loop tying them together;
 - :mod:`__main__` — the CLI: ``python -m
   simclr_pytorch_distributed_tpu.supervise [flags] -- python
-  main_supcon.py ...`` (what ``run_supcon.sh`` delegates to).
+  main_supcon.py ...`` (what ``run_supcon.sh`` delegates to);
+- :mod:`replica` / :mod:`replica_fleet` — the same discipline generalized
+  from one trainer to N SERVING replicas: a pure ``ReplicaPolicy``
+  decision table (liveness from the ``serve_batcher_last_completion_age_s``
+  gauge, saturation from occupancy/queue depth, per-replica restart
+  budgets) and the ``ReplicaFleetSupervisor`` subprocess loop that spawns /
+  restarts / drains ``serve.fleet`` replicas off scraped ``/metrics``.
 
 Proof vehicle: the PR-1 subprocess fault harness drives the REAL
 supervisor through kill -9 / stall / collapse / preempt-then-resize
@@ -41,6 +47,15 @@ from simclr_pytorch_distributed_tpu.supervise.policy import (  # noqa: F401
     Decision,
     DecisionPolicy,
     ExitObservation,
+)
+from simclr_pytorch_distributed_tpu.supervise.replica import (  # noqa: F401
+    ReplicaDecision,
+    ReplicaObservation,
+    ReplicaPolicy,
+)
+from simclr_pytorch_distributed_tpu.supervise.replica_fleet import (  # noqa: F401
+    ReplicaFleetConfig,
+    ReplicaFleetSupervisor,
 )
 from simclr_pytorch_distributed_tpu.supervise.supervisor import (  # noqa: F401
     SuperviseConfig,

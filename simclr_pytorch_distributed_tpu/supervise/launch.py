@@ -114,8 +114,12 @@ def topology_env(
 
 
 # the rebalance hint a restart_rebalanced relaunch carries: "<host>:<factor>"
-# (which process runs at what fraction of its uniform share)
-FLEET_SHARE_ENV = "FLEET_SHARE_HINT"
+# (which process runs at what fraction of its uniform share). The canonical
+# spelling lives with the consumer — data/pipeline.py parses it into
+# share_splits() — and is re-exported here for the producer side.
+from simclr_pytorch_distributed_tpu.data.pipeline import (  # noqa: E402,F401
+    FLEET_SHARE_ENV,
+)
 
 
 def share_env(

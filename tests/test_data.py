@@ -283,3 +283,75 @@ def test_global_batch_composition_is_mesh_shape_independent():
     assert not np.array_equal(
         np.concatenate(ref), np.concatenate(global_batches(1, epoch=5))
     )
+
+
+def test_share_hint_parsing_is_forgiving():
+    from simclr_pytorch_distributed_tpu.data.pipeline import parse_share_hint
+
+    assert parse_share_hint("1:0.5") == (1, 0.5)
+    assert parse_share_hint("0:1.0") == (0, 1.0)
+    for bad in (None, "", "garbage", "1:", ":0.5", "1:0", "1:-0.5",
+                "1:1.5", "-1:0.5", "1:nan", "x:0.5"):
+        assert parse_share_hint(bad) is None, bad
+
+
+def test_share_splits_invariants():
+    """Whatever the hint, the bounds are a contiguous partition of the
+    global batch with every process keeping at least one row — the
+    invariant the collective-participation contract needs."""
+    from simclr_pytorch_distributed_tpu.data.pipeline import share_splits
+
+    assert share_splits(64, 4) == [(0, 16), (16, 32), (32, 48), (48, 64)]
+    b = share_splits(64, 4, "1:0.5")
+    sizes = [hi - lo for lo, hi in b]
+    assert sizes[1] == 8 and sum(sizes) == 64  # host 1 sheds half its share
+    for hint in (None, "0:0.5", "3:0.25", "2:0.01", "9:0.5", "bad", "1:1.0"):
+        bounds = share_splits(96, 4, hint)
+        sizes = [hi - lo for lo, hi in bounds]
+        assert bounds[0][0] == 0 and bounds[-1][1] == 96
+        assert sum(sizes) == 96 and all(s >= 1 for s in sizes)
+        assert all(
+            bounds[i][1] == bounds[i + 1][0] for i in range(len(bounds) - 1)
+        )
+    # out-of-range host and single-process hints degrade to uniform
+    assert share_splits(96, 4, "9:0.5") == share_splits(96, 4)
+    assert share_splits(96, 1, "0:0.5") == [(0, 96)]
+    # an extreme factor still leaves the slow host one row, never zero
+    tiny = share_splits(8, 4, "2:0.01")
+    assert tiny[2][1] - tiny[2][0] == 1
+
+
+def test_share_hint_preserves_global_batch_composition():
+    """FLEET_SHARE_HINT consumption (supervise/launch.py share_env -> this
+    loader): an uneven split moves rows BETWEEN processes but the union of
+    the per-process slices is bit-identical to the uniform split's — the
+    epoch permutation, not the share, defines what the fleet consumes."""
+    images = np.arange(96)[:, None].astype(np.uint8)
+    labels = np.arange(96).astype(np.int32)
+
+    def global_batches(share_hint):
+        loaders = [
+            EpochLoader(
+                images, labels, global_batch_size=32, base_seed=11,
+                process_index=p, process_count=4, prefetch=0,
+                share_hint=share_hint,
+            )
+            for p in range(4)
+        ]
+        return [
+            np.concatenate([lab for _, lab in parts])
+            for parts in zip(*[list(l.epoch(3)) for l in loaders])
+        ]
+
+    ref = global_batches(None)
+    skew = global_batches("2:0.5")
+    for a, b in zip(ref, skew):
+        np.testing.assert_array_equal(a, b)
+    # and the hinted process genuinely carries fewer rows
+    slow = EpochLoader(
+        images, labels, global_batch_size=32, base_seed=11,
+        process_index=2, process_count=4, prefetch=0, share_hint="2:0.5",
+    )
+    _, lab = next(iter(slow.epoch(3)))
+    assert len(lab) == 4  # half of the uniform 8
+    assert slow.share_bounds[2] == (slow._lo, slow._hi)

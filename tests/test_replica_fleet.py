@@ -1,0 +1,445 @@
+"""Replica-fleet supervision tests (supervise/replica.py + replica_fleet.py).
+
+Same discipline as test_supervise.py's policy table: ``classify`` and
+``ReplicaPolicy.decide`` are pure, so every row of the decision table is
+enumerated without a process or a clock. The supervisor loop runs on fake
+Popen/scraper/clock — spawn-to-floor, restart-on-kill (same port), budget
+exhaustion to give-up, saturation scale-up, idle scale-down — end to end
+in milliseconds. The REAL subprocess scenario (live HTTP replicas, kill -9,
+promote under load) is scripts/serve_fleet_scenario.py, whose committed
+evidence scripts/ratchet.py gates.
+"""
+
+import itertools
+import json
+import os
+import sys
+
+import pytest
+
+from simclr_pytorch_distributed_tpu.supervise.replica import (
+    AGE_GAUGE,
+    BUSY,
+    DEAD,
+    DRAIN,
+    GIVE_UP,
+    IDLE,
+    INFLIGHT_GAUGE,
+    OCC_GAUGE,
+    QUEUE_GAUGE,
+    RESTART,
+    SATURATED,
+    SPAWN,
+    STALLED,
+    STARTING,
+    UNSCRAPEABLE,
+    ReplicaObservation,
+    ReplicaPolicy,
+    classify,
+)
+from simclr_pytorch_distributed_tpu.supervise.replica_fleet import (
+    ReplicaFleetConfig,
+    ReplicaFleetSupervisor,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.supervisor, pytest.mark.servefleet]
+
+
+def gauges(queued=0.0, inflight=0.0, age=0.0, occ=0.0):
+    return {
+        QUEUE_GAUGE: queued, INFLIGHT_GAUGE: inflight,
+        AGE_GAUGE: age, OCC_GAUGE: occ,
+    }
+
+
+def obs(rid=0, alive=True, metrics=None, age_s=0.0):
+    return ReplicaObservation(rid, alive, metrics, age_s)
+
+
+# --------------------------------------------------------------- classify
+
+
+def test_classify_exhaustive_over_the_condition_grid():
+    """Every combination of the table's binary conditions lands in exactly
+    the documented class — the table has no unreachable or ambiguous row.
+
+    Grid axes: alive, scraped, past startup grace, work pending, completion
+    age past stall threshold, occupancy high, queue high, fully quiescent.
+    """
+    P = dict(startup_grace_s=60.0, stall_age_s=30.0,
+             occ_hi=0.9, queue_hi=64.0, occ_lo=0.1)
+    for alive, scraped in itertools.product([False, True], repeat=2):
+        for young, pending, stale, occ_hi, q_hi in itertools.product(
+            [False, True], repeat=5
+        ):
+            m = gauges(
+                queued=80.0 if q_hi else (1.0 if pending else 0.0),
+                inflight=1.0 if pending else 0.0,
+                age=99.0 if stale else 1.0,
+                occ=0.95 if occ_hi else 0.5,
+            )
+            o = obs(alive=alive, metrics=m if scraped else None,
+                    age_s=5.0 if young else 120.0)
+            got = classify(o, **P)
+            if not alive:
+                assert got == DEAD
+            elif not scraped:
+                assert got == (STARTING if young else UNSCRAPEABLE)
+            elif (pending or q_hi) and stale:
+                assert got == STALLED
+            elif occ_hi or q_hi:
+                assert got == SATURATED
+            else:
+                assert got == BUSY  # occ 0.5 > occ_lo, never idle here
+
+
+def test_classify_idle_requires_full_quiescence():
+    assert classify(obs(metrics=gauges())) == IDLE
+    assert classify(obs(metrics=gauges(occ=0.05))) == IDLE
+    # ANY of queued / inflight / occupancy above the floor blocks idle
+    assert classify(obs(metrics=gauges(queued=1))) == BUSY
+    assert classify(obs(metrics=gauges(inflight=1))) == BUSY
+    assert classify(obs(metrics=gauges(occ=0.5))) == BUSY
+
+
+def test_classify_thresholds_are_inclusive_where_documented():
+    assert classify(obs(metrics=gauges(occ=0.9))) == SATURATED       # >=
+    assert classify(obs(metrics=gauges(queued=64))) == SATURATED     # >=
+    assert classify(obs(metrics=gauges(occ=0.1))) == IDLE            # <=
+    assert classify(obs(metrics=None, age_s=60.0)) == STARTING       # <=
+    # stall is strict: exactly the threshold is not yet a stall
+    assert classify(obs(metrics=gauges(queued=1, age=30.0))) == BUSY
+
+
+# ----------------------------------------------------------------- policy
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        ReplicaPolicy(0, 4)
+    with pytest.raises(ValueError):
+        ReplicaPolicy(3, 2)
+    with pytest.raises(ValueError):
+        ReplicaPolicy(1, 2, unscrape_strikes=0)
+
+
+def test_dead_replica_restarts_until_budget_then_gives_up():
+    p = ReplicaPolicy(1, 4, max_restarts=2)
+    busy = obs(1, metrics=gauges(queued=1, inflight=1, age=1, occ=0.5))
+    for expect in (1, 2):
+        d = p.decide([obs(0, alive=False), busy])
+        assert [x.action for x in d] == [RESTART]
+        assert d[0].replica == 0 and f"{expect}/2" in d[0].reason
+    d = p.decide([obs(0, alive=False), busy])
+    assert [x.action for x in d] == [GIVE_UP]
+    assert p.given_up == {0}
+    # the abandoned slot is ignored thereafter; fleet still >= min via 1
+    assert p.decide([obs(0, alive=False), busy]) == []
+
+
+def test_stalled_replica_is_repaired_with_the_age_in_the_reason():
+    p = ReplicaPolicy(1, 4)
+    d = p.decide([obs(0, metrics=gauges(queued=3, age=45.0))])
+    assert d[0].action == RESTART and "45.0s" in d[0].reason
+
+
+def test_unscrapeable_needs_consecutive_strikes_and_recovery_resets():
+    p = ReplicaPolicy(1, 4, unscrape_strikes=3)
+    gone = obs(0, metrics=None, age_s=120.0)
+    ok = obs(0, metrics=gauges(queued=1, inflight=1, age=1, occ=0.5))
+    assert p.decide([gone]) == []          # strike 1
+    assert p.decide([gone]) == []          # strike 2
+    assert p.decide([ok]) == []            # recovery resets the count
+    assert p.decide([gone]) == []          # strike 1 again
+    assert p.decide([gone]) == []
+    d = p.decide([gone])                   # strike 3: escalate
+    assert [x.action for x in d] == [RESTART]
+
+
+def test_fleet_below_min_spawns():
+    p = ReplicaPolicy(2, 4)
+    d = p.decide([obs(0, metrics=gauges(queued=1, occ=0.5, inflight=1, age=1))])
+    assert [x.action for x in d] == [SPAWN] and d[0].replica == -1
+
+
+def test_saturation_spawns_one_per_tick_up_to_max():
+    p = ReplicaPolicy(1, 2)
+    hot = obs(0, metrics=gauges(occ=0.95))
+    d = p.decide([hot])
+    assert [x.action for x in d] == [SPAWN]
+    # at max: saturation no longer spawns
+    hot2 = obs(1, metrics=gauges(occ=0.95))
+    assert p.decide([hot, hot2]) == []
+
+
+def test_idle_drains_highest_id_only_without_saturation_above_min():
+    p = ReplicaPolicy(1, 4)
+    idle0 = obs(0, metrics=gauges())
+    idle2 = obs(2, metrics=gauges())
+    busy1 = obs(1, metrics=gauges(queued=1, inflight=1, age=1, occ=0.5))
+    d = p.decide([idle0, busy1, idle2])
+    assert [(x.action, x.replica) for x in d] == [(DRAIN, 2)]
+    # at min: idle never drains below the floor
+    p2 = ReplicaPolicy(1, 4)
+    assert p2.decide([idle0]) == []
+    # saturation anywhere suppresses draining (the fleet is not oversized)
+    p3 = ReplicaPolicy(1, 4)
+    hot = obs(1, metrics=gauges(occ=0.95))
+    d = p3.decide([idle0, hot])
+    assert all(x.action != DRAIN for x in d)
+
+
+def test_repair_and_scaling_compose_in_one_tick():
+    """A dead replica and a below-min fleet produce repair AND spawn in the
+    same decide call — recovery does not wait a tick behind sizing."""
+    p = ReplicaPolicy(3, 4, max_restarts=0)  # dead -> immediate give-up
+    busy = obs(1, metrics=gauges(queued=1, inflight=1, age=1, occ=0.5))
+    d = p.decide([obs(0, alive=False), busy])
+    assert [x.action for x in d] == [GIVE_UP, SPAWN]
+
+
+# ------------------------------------------------------- supervisor (fakes)
+
+
+class FakeProc:
+    def __init__(self, cmd):
+        self.cmd = cmd
+        self.returncode = None
+
+    def poll(self):
+        return self.returncode
+
+    def wait(self):
+        return self.returncode if self.returncode is not None else 0
+
+    def send_signal(self, _sig):
+        self.returncode = -15
+
+    def kill(self):
+        self.returncode = -9
+
+
+class FakeScraper:
+    def __init__(self, port):
+        self.port = port
+        self.metrics = None
+
+    def scrape(self):
+        return self.metrics
+
+
+@pytest.fixture()
+def harness():
+    state = {"t": 0.0, "procs": [], "scrapers": {}}
+    ports = itertools.count(9000)
+
+    def popen(cmd, env=None):
+        p = FakeProc(cmd)
+        state["procs"].append(p)
+        return p
+
+    def fake_sleep(seconds):
+        state["t"] += seconds
+
+    def sup(policy, **cfg_kwargs):
+        cfg = ReplicaFleetConfig(
+            command=["serve", "--port", "{port}"], grace_s=1.0, **cfg_kwargs
+        )
+        return ReplicaFleetSupervisor(
+            cfg, policy, popen=popen,
+            clock=lambda: state["t"],
+            sleep=fake_sleep,
+            free_port=lambda: next(ports),
+            scraper_factory=lambda port: state["scrapers"].setdefault(
+                port, FakeScraper(port)
+            ),
+        )
+
+    return sup, state
+
+
+BUSY_M = gauges(queued=1, inflight=1, age=1, occ=0.5)
+
+
+def test_supervisor_spawns_to_floor_and_substitutes_the_port(harness):
+    make, state = harness
+    sup = make(ReplicaPolicy(2, 3))
+    assert [r["action"] for r in sup.step()] == [SPAWN]
+    assert [r["action"] for r in sup.step()] == [SPAWN]
+    assert len(sup.replicas()) == 2
+    assert state["procs"][0].cmd == ["serve", "--port", "9000"]
+    assert state["procs"][1].cmd == ["serve", "--port", "9001"]
+    for s in state["scrapers"].values():
+        s.metrics = dict(BUSY_M)
+    assert sup.step() == []  # steady state
+    sup.stop_all()
+    assert sup.replicas() == {}
+    assert all(p.returncode is not None for p in state["procs"])
+
+
+def test_supervisor_restarts_killed_replica_on_the_same_port(harness):
+    make, state = harness
+    sup = make(ReplicaPolicy(2, 3, max_restarts=1))
+    sup.step(); sup.step()
+    for s in state["scrapers"].values():
+        s.metrics = dict(BUSY_M)
+    state["procs"][0].returncode = -9  # kill -9 replica 0
+    d = sup.step()
+    assert [r["action"] for r in d] == [RESTART]
+    assert d[0]["replica"] == 0 and d[0]["port"] == 9000  # SAME port
+    assert d[0]["old_returncode"] == -9
+    assert sup.replicas()[0] == {
+        "port": 9000, "pid": None, "alive": True, "restarts": 1,
+    }
+
+
+def test_supervisor_budget_exhaustion_gives_up_then_backfills(harness):
+    make, state = harness
+    sup = make(ReplicaPolicy(2, 3, max_restarts=0))
+    sup.step(); sup.step()
+    for s in state["scrapers"].values():
+        s.metrics = dict(BUSY_M)
+    state["procs"][0].returncode = -9
+    d = sup.step()
+    assert [r["action"] for r in d] == [GIVE_UP, SPAWN]
+    assert sup.gave_up() == [0]
+    assert sorted(sup.replicas()) == [1, 2]  # fresh slot, fresh id
+
+
+def test_supervisor_scales_up_on_saturation_and_drains_idle(harness):
+    make, state = harness
+    sup = make(ReplicaPolicy(1, 2))
+    sup.step()
+    state["scrapers"][9000].metrics = gauges(occ=0.95)
+    d = sup.step()
+    assert [r["action"] for r in d] == [SPAWN]
+    assert len(sup.replicas()) == 2
+    state["scrapers"][9000].metrics = dict(BUSY_M)
+    state["scrapers"][9001].metrics = gauges()  # newest idle
+    d = sup.step()
+    assert [(r["action"], r["replica"]) for r in d] == [(DRAIN, 1)]
+    assert sorted(sup.replicas()) == [0]
+
+
+def test_supervisor_run_until_predicate(harness):
+    make, state = harness
+    sup = make(ReplicaPolicy(1, 2))
+    sup.run(until=lambda: len(sup.replicas()) >= 1)
+    assert len(sup.replicas()) == 1
+    assert [r["action"] for r in sup.decisions()] == [SPAWN]
+
+
+# ------------------------------------------- committed evidence + ratchet gate
+
+
+def _gate():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import ratchet
+
+    return ratchet
+
+
+def sample_fleet_artifact():
+    return {
+        "metric": "serve_fleet_scenario",
+        "schema": "serve_fleet/v1",
+        "phases": {
+            "spawn": {
+                "ok": True,
+                "replicas": {"0": {"port": 9000}, "1": {"port": 9001}},
+                "warm_embed": {"0": {"status": 200}, "1": {"status": 200}},
+            },
+            "restart": {
+                "ok": True, "replica": 0, "port": 9000,
+                "decisions": [{"action": "restart_replica", "replica": 0,
+                               "port": 9000, "old_returncode": -9}],
+                "served_after_restart": True,
+            },
+            "promote": {
+                "ok": True, "response": {"model": "prod", "version": 2,
+                                         "draining": 1},
+                "embed_ok": 500, "embed_failures": {},
+                "versions": {"1": "retired", "2": "serving"},
+                "drained": True,
+            },
+            "neighbors": {
+                "ok": True, "self_top1": True, "top1_score": 0.99999,
+            },
+        },
+        "gave_up": [],
+        "ok": True,
+    }
+
+
+def test_serve_fleet_gate_record_accepts_complete_artifact():
+    r = _gate().serve_fleet_gate_record(sample_fleet_artifact())
+    assert r["ok"], r
+    assert r["metric"] == "ratchet_serve_fleet"
+    assert sorted(r["phases"]) == ["neighbors", "promote", "restart", "spawn"]
+
+
+def test_serve_fleet_gate_record_rejects_weakened_evidence():
+    """Each load-bearing claim, individually removed, must fail the gate —
+    a hand-edited artifact cannot sneak past on phase ok flags alone."""
+    gate = _gate()
+    art = sample_fleet_artifact()
+    art["schema"] = "serve_fleet/v0"
+    assert not gate.serve_fleet_gate_record(art)["ok"]
+
+    art = sample_fleet_artifact()
+    del art["phases"]["promote"]
+    r = gate.serve_fleet_gate_record(art)
+    assert not r["ok"] and "promote" in r["error"]
+
+    # a single-replica fleet proves nothing about the floor
+    art = sample_fleet_artifact()
+    del art["phases"]["spawn"]["replicas"]["1"]
+    assert not gate.serve_fleet_gate_record(art)["ok"]
+
+    # a restart that changed port broke the address contract
+    art = sample_fleet_artifact()
+    art["phases"]["restart"]["decisions"][0]["port"] = 9005
+    r = gate.serve_fleet_gate_record(art)
+    assert not r["ok"] and "port" in r["error"]
+
+    # the kill must really have been a SIGKILL, not a clean exit
+    art = sample_fleet_artifact()
+    art["phases"]["restart"]["decisions"][0]["old_returncode"] = 0
+    assert not gate.serve_fleet_gate_record(art)["ok"]
+
+    # ANY dropped request across the swap window is disqualifying
+    art = sample_fleet_artifact()
+    art["phases"]["promote"]["embed_failures"] = {"http_503": 1}
+    r = gate.serve_fleet_gate_record(art)
+    assert not r["ok"] and "dropped" in r["error"]
+
+    # a swap with no live load proves nothing about draining
+    art = sample_fleet_artifact()
+    art["phases"]["promote"]["embed_ok"] = 3
+    assert not gate.serve_fleet_gate_record(art)["ok"]
+
+    art = sample_fleet_artifact()
+    art["phases"]["promote"]["drained"] = False
+    assert not gate.serve_fleet_gate_record(art)["ok"]
+
+    art = sample_fleet_artifact()
+    art["phases"]["neighbors"]["top1_score"] = 0.42
+    assert not gate.serve_fleet_gate_record(art)["ok"]
+
+    # an abandoned slot means the fleet did not actually hold its floor
+    art = sample_fleet_artifact()
+    art["gave_up"] = [0]
+    assert not gate.serve_fleet_gate_record(art)["ok"]
+
+
+def test_committed_fleet_evidence_passes_the_gate():
+    """docs/evidence/serve_fleet_r17.json — produced by
+    scripts/serve_fleet_scenario.py driving a REAL supervised replica fleet
+    — must satisfy the same pure gate ratchet runs."""
+    path = os.path.join(REPO, "docs", "evidence", "serve_fleet_r17.json")
+    with open(path) as f:
+        artifact = json.load(f)
+    r = _gate().serve_fleet_gate_record(artifact)
+    assert r["ok"], r

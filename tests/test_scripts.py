@@ -347,6 +347,19 @@ def test_serve_bench_sweep_smoke_end_to_end(tmp_path):
     assert artifact["engine_stats"]["traces"] == {"2": 1, "8": 1}
     assert artifact["http"]["healthz"] == "ok"
     assert artifact["saturated_speedup"] > 0
+    # the mixed-tenant multi-model arm: both hosted versions served their
+    # skewed tenant's requests through the registry with zero errors
+    mm = artifact["multi_model"]
+    assert mm["tenancy"] == {"bulk": "prod", "interactive": "canary"}
+    assert mm["requests"] > 0 and mm["throughput_imgs_per_s"] > 0
+    per_model = mm["per_model"]
+    assert set(per_model) == {"prod", "canary"}
+    assert per_model["prod"]["requests"] > per_model["canary"]["requests"]
+    for m in per_model.values():
+        assert m["errors"] == 0
+        if m["latency"]:
+            assert m["latency"]["p50_ms"] <= m["latency"]["p99_ms"]
+    assert mm["admission"]["rejected"] == 0  # quota disabled in the bench
 
 
 # -------------------------------------------------------------- xplane_bw

@@ -1,0 +1,524 @@
+"""serve/fleet tests: registry routing, the Events-gated hot-swap drain,
+FIFO across a promote, cache identity across byte-identical weights, the
+retrieval index vs a numpy oracle, admission quotas, and the HTTP frontend.
+
+Layering mirrors the serve suite: registry/admission/frontend tests run on
+per-row FAKE engines (no jax compiles — the hot-swap drain proof gates the
+fake's result() on a threading.Event, so the in-flight window is held open
+deterministically, not by sleeping); the cache-staleness pin uses two REAL
+engines built from the same seed (byte-identical weights — the exact case
+only the ``name@version`` identity key can distinguish); NeighborIndex
+compiles one tiny matmul per query bucket.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu.serve.batcher import QueueFull
+from simclr_pytorch_distributed_tpu.serve.cache import EmbeddingCache
+from simclr_pytorch_distributed_tpu.serve.engine import EmbeddingEngine
+from simclr_pytorch_distributed_tpu.serve.fleet import (
+    AdmissionController,
+    ModelRegistry,
+    NeighborIndex,
+)
+from simclr_pytorch_distributed_tpu.serve.fleet.frontend import (
+    create_fleet_server,
+    fleet_metrics_fn,
+)
+from simclr_pytorch_distributed_tpu.serve.server import start_in_thread
+
+pytestmark = [pytest.mark.serve, pytest.mark.servefleet]
+
+H = W = 2
+
+
+def imgs(*values):
+    out = np.zeros((len(values), H, W, 3), np.uint8)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
+
+
+class FakeHandle:
+    def __init__(self, engine, images):
+        self._engine = engine
+        self._images = images
+        self.n_rows = len(images)
+
+    def done(self):
+        gate = self._engine.gate
+        return gate is None or gate.is_set()
+
+    def result(self):
+        gate = self._engine.gate
+        if gate is not None:
+            assert gate.wait(30), "test gate never opened"
+        return self._engine.rows(self._images)
+
+
+class FakeEngine:
+    """Per-row map with the engine's dispatch surface. ``scale`` makes each
+    version's output distinguishable (WHICH engine served a row is the fact
+    the drain tests assert); ``gate`` holds every dispatched batch's
+    completion until the test releases it."""
+
+    feat_dim = 3
+
+    def __init__(self, scale=1.0, gate=None):
+        self.scale = scale
+        self.gate = gate
+        self.identity = ""
+
+    def set_identity(self, identity):
+        self.identity = identity
+
+    def rows(self, images):
+        # distinct image values get distinct DIRECTIONS (v, v^2, 1), so
+        # cosine retrieval over fake embeddings is tie-free; ``scale``
+        # changes magnitude only
+        v = np.asarray(images, np.float32).reshape(len(images), -1)[:, :1] + 1.0
+        return np.hstack([v, v ** 2, np.ones_like(v)]) * self.scale
+
+    def validate_images(self, images):
+        images = np.asarray(images)
+        if images.ndim != 4 or images.shape[0] == 0:
+            raise ValueError("need a non-empty [N,H,W,3] batch")
+        return images
+
+    def bucket_for(self, n):
+        return n
+
+    def dispatch(self, images):
+        return FakeHandle(self, images)
+
+    def stats(self):
+        return {"identity": self.identity, "fake": True}
+
+
+def make_registry(**kwargs):
+    kwargs.setdefault("batcher_kwargs", {"max_wait_ms": 1})
+    kwargs.setdefault("index_capacity", 0)
+    return ModelRegistry(**kwargs)
+
+
+def wait_for(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.002)
+    return False
+
+
+# ------------------------------------------------------------ registry core
+
+
+def test_routing_default_and_explicit():
+    reg = make_registry()
+    try:
+        reg.add_model("prod", FakeEngine(scale=1.0))
+        reg.add_model("exp", FakeEngine(scale=10.0))
+        assert reg.default_model() == "exp"  # newest added wins the default
+        x = imgs(2)
+        name, fut = reg.submit(x)
+        assert name == "exp"
+        np.testing.assert_array_equal(fut.result(5), FakeEngine(10.0).rows(x))
+        name, fut = reg.submit(x, model="prod")
+        assert name == "prod"
+        np.testing.assert_array_equal(fut.result(5), FakeEngine(1.0).rows(x))
+    finally:
+        reg.close()
+
+
+def test_duplicate_and_unknown_models():
+    reg = make_registry()
+    try:
+        reg.add_model("m", FakeEngine())
+        with pytest.raises(ValueError, match="already hosted"):
+            reg.add_model("m", FakeEngine())
+        with pytest.raises(KeyError):
+            reg.submit(imgs(1), model="nope")
+        with pytest.raises(KeyError):
+            reg.promote("nope", FakeEngine())
+        with pytest.raises(KeyError):
+            reg.wait_drained("m", 7, timeout=0)
+    finally:
+        reg.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        reg.add_model("late", FakeEngine())
+
+
+def test_submit_with_no_models_is_keyerror():
+    reg = make_registry()
+    try:
+        with pytest.raises(KeyError, match="no models"):
+            reg.submit(imgs(1))
+    finally:
+        reg.close()
+
+
+# ----------------------------------------------------------- hot-swap drain
+
+
+def test_hot_swap_drains_inflight_on_old_engine():
+    """THE promote contract: a batch in flight when promote() lands
+    completes on the OLD engine (its rows carry the old scale), the old
+    version retires only after that completion, and nothing fails. The
+    in-flight window is held open by an Event, so the swap provably
+    happens DURING the batch, not around it."""
+    gate = threading.Event()
+    old = FakeEngine(scale=1.0, gate=gate)
+    reg = make_registry()
+    try:
+        mv1 = reg.add_model("m", old)
+        assert old.identity == "m@v1"
+        x1 = imgs(3, 4)
+        _, f1 = reg.submit(x1)
+        assert wait_for(lambda: mv1.inflight > 0)  # dispatched, gated
+
+        new = FakeEngine(scale=5.0)
+        mv2 = reg.promote("m", new)
+        assert (mv1.state, mv2.state) == ("draining", "serving")
+        assert new.identity == "m@v2"
+        assert not reg.wait_drained("m", 1, timeout=0.05)  # pinned by f1
+        assert not f1.done()
+
+        x2 = imgs(7)
+        _, f2 = reg.submit(x2)  # routes to v2
+
+        gate.set()
+        np.testing.assert_array_equal(f1.result(5), old.rows(x1))  # scale 1
+        np.testing.assert_array_equal(f2.result(5), new.rows(x2))  # scale 5
+        assert reg.wait_drained("m", 1, timeout=5)
+        assert mv1.state == "retired" and mv1.engine is None
+        s = reg.stats()["models"]["m"]
+        assert s["batcher"]["errors"] == 0 and s["batcher"]["timeouts"] == 0
+        assert s["serving"] == 2
+        assert [v["state"] for v in s["versions"]] == ["retired", "serving"]
+    finally:
+        reg.close()
+
+
+def test_fifo_holds_across_the_swap():
+    """Completion order is submit order even when a promote lands between
+    two requests: the post-swap request (on the fast new engine) must NOT
+    overtake the gated pre-swap one."""
+    gate = threading.Event()
+    reg = make_registry()
+    try:
+        mv1 = reg.add_model("m", FakeEngine(scale=1.0, gate=gate))
+        order = []
+        _, f1 = reg.submit(imgs(1))
+        f1.add_done_callback(lambda _f: order.append(1))
+        assert wait_for(lambda: mv1.inflight > 0)  # dispatched pre-swap
+        reg.promote("m", FakeEngine(scale=2.0))
+        _, f2 = reg.submit(imgs(2))
+        f2.add_done_callback(lambda _f: order.append(2))
+        # the new engine is ungated, but FIFO pins f2 behind f1
+        time.sleep(0.05)
+        assert not f2.done() and order == []
+        gate.set()
+        f2.result(5)
+        assert wait_for(lambda: len(order) == 2)
+        assert order == [1, 2]
+    finally:
+        reg.close()
+
+
+def test_queued_requests_retarget_to_the_new_version():
+    """Requests still QUEUED (not dispatched) at promote time dispatch on
+    the new engine — only dispatched work drains on the old one."""
+    reg = ModelRegistry(
+        batcher_kwargs={"max_wait_ms": 1, "start": False},
+        index_capacity=0,
+    )
+    try:
+        reg.add_model("m", FakeEngine(scale=1.0))
+        x = imgs(6)
+        _, fut = reg.submit(x)  # queued; no worker threads to dispatch it
+        mv2 = reg.promote("m", FakeEngine(scale=3.0))
+        b = reg.batcher("m")
+        b._dispatch(b._next_batch())
+        np.testing.assert_array_equal(
+            fut.result(5), FakeEngine(3.0).rows(x)
+        )
+        assert mv2.inflight == 0  # completed and released
+        assert reg.wait_drained("m", 1, timeout=5)  # v1 never pinned
+    finally:
+        reg.close()
+
+
+# ------------------------------------------------- cache identity (real jax)
+
+
+def test_shared_cache_misses_after_swap_to_identical_weights():
+    """Satellite (a): the cache key carries ``name@version``. Two engines
+    from the SAME seed have byte-identical weights — same weights probe —
+    so without the identity component a post-swap request would be a stale
+    HIT. Pinned: post-swap requests miss, then re-hit under the new key."""
+    shared = EmbeddingCache(capacity=256)
+    e1 = EmbeddingEngine.random_init(
+        model_name="resnet10", size=8, seed=0, buckets=(2,), cache=shared
+    )
+    e2 = EmbeddingEngine.random_init(
+        model_name="resnet10", size=8, seed=0, buckets=(2,), cache=shared
+    )
+    assert e1._weights_probe == e2._weights_probe  # the trap being defused
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(2, 8, 8, 3), dtype=np.uint8)
+
+    reg = make_registry()
+    try:
+        reg.add_model("m", e1)
+        _, f = reg.submit(x)
+        first = f.result(30)
+        assert e1.stats()["cache_hit_rows"] == 0
+        _, f = reg.submit(x)
+        np.testing.assert_array_equal(f.result(30), first)
+        assert e1.stats()["cache_hit_rows"] == 2  # warm under m@v1
+
+        reg.promote("m", e2)
+        _, f = reg.submit(x)
+        np.testing.assert_array_equal(f.result(30), first)  # same weights
+        assert e2.stats()["cache_hit_rows"] == 0  # m@v2 key: MISS, not stale
+        _, f = reg.submit(x)
+        f.result(30)
+        assert e2.stats()["cache_hit_rows"] == 2  # and re-warms under v2
+    finally:
+        reg.close()
+
+
+# ------------------------------------------------------------------ admission
+
+
+def test_admission_controller_quota_and_release():
+    adm = AdmissionController(max_tenant_rows=4)
+    rel_a = adm.admit("m", "a", 3)
+    adm.admit("m", "b", 4)  # different tenant: independent bucket
+    with pytest.raises(QueueFull, match="tenant"):
+        adm.admit("m", "a", 2)  # 3+2 > 4
+    rel_a()
+    adm.admit("m", "a", 4)  # released rows freed the quota
+    s = adm.stats()
+    assert s["rejected"] == 1 and s["admitted"] == 3
+    # disabled controller admits anything
+    assert AdmissionController(0).admit("m", "t", 10 ** 6)() is None
+
+
+def test_admission_releases_when_the_future_resolves():
+    gate = threading.Event()
+    reg = ModelRegistry(
+        batcher_kwargs={"max_wait_ms": 1},
+        admission=AdmissionController(max_tenant_rows=2),
+        index_capacity=0,
+    )
+    try:
+        reg.add_model("m", FakeEngine(gate=gate))
+        _, f1 = reg.submit(imgs(1, 2), tenant="t")  # 2 rows: quota full
+        with pytest.raises(QueueFull):
+            reg.submit(imgs(3), tenant="t")
+        _, f_other = reg.submit(imgs(3), tenant="u")  # other tenants fine
+        gate.set()
+        f1.result(5)
+        f_other.result(5)
+        # completion released the rows: the same tenant admits again
+        assert wait_for(
+            lambda: reg.admission.stats()["outstanding_rows"] == 0
+        )
+        _, f2 = reg.submit(imgs(4, 5), tenant="t")
+        f2.result(5)
+    finally:
+        reg.close()
+
+
+# ------------------------------------------------------------------ retrieval
+
+
+def test_neighbor_index_matches_numpy_oracle():
+    dim, n = 16, 40
+    rng = np.random.default_rng(0)
+    rows = rng.normal(size=(n, dim)).astype(np.float32)
+    keys = [f"k{i}" for i in range(n)]
+    index = NeighborIndex(dim, capacity=64)
+    index.add(keys, rows)
+    queries = rng.normal(size=(5, dim)).astype(np.float32)
+    got = index.query(queries, k=7)
+
+    unit = rows / np.linalg.norm(rows, axis=1, keepdims=True)
+    q_unit = queries / np.linalg.norm(queries, axis=1, keepdims=True)
+    scores = q_unit @ unit.T
+    for qi, hits in enumerate(got):
+        oracle = np.argsort(-scores[qi])[:7]
+        assert [key for key, _ in hits] == [keys[j] for j in oracle]
+        np.testing.assert_allclose(
+            [s for _, s in hits], scores[qi][oracle], rtol=1e-5, atol=1e-5
+        )
+
+
+def test_neighbor_index_lru_eviction_and_update_refresh():
+    rng = np.random.default_rng(1)
+    rows = rng.normal(size=(6, 4)).astype(np.float32)
+    index = NeighborIndex(4, capacity=4)
+    index.add([f"k{i}" for i in range(4)], rows[:4])
+    index.add(["k0"], rows[4:5])  # UPDATE refreshes k0's LRU position
+    index.add(["k4"], rows[5:6])  # evicts k1 (oldest untouched), not k0
+    assert len(index) == 4
+    held = {key for key, _ in index.query(rows[0:1], k=4)[0]}
+    assert held == {"k0", "k2", "k3", "k4"}
+    s = index.stats()
+    assert s["evictions"] == 1 and s["updates"] == 1 and s["inserts"] == 5
+    # the updated k0 now scores as its NEW vector
+    top_key, top_score = index.query(rows[4:5], k=1)[0][0]
+    assert top_key == "k0" and top_score == pytest.approx(1.0, abs=1e-5)
+
+
+def test_neighbor_index_empty_clear_and_small_k():
+    index = NeighborIndex(4, capacity=8)
+    assert index.query(np.ones((2, 4), np.float32), k=3) == [[], []]
+    index.add(["a", "b"], np.eye(4, dtype=np.float32)[:2])
+    got = index.query(np.eye(4, dtype=np.float32)[:1], k=10)[0]
+    assert [k for k, _ in got] == ["a", "b"]  # k clamps to the 2 entries
+    index.clear()
+    assert len(index) == 0
+    assert index.query(np.ones((1, 4), np.float32), k=1) == [[]]
+
+
+# ------------------------------------------------------------- HTTP frontend
+
+
+def post(base, path, obj, timeout=10):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def get_raw(base, path, timeout=10):
+    with urllib.request.urlopen(f"{base}{path}", timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+@pytest.fixture()
+def fleet():
+    reg = ModelRegistry(
+        batcher_kwargs={"max_wait_ms": 1},
+        admission=AdmissionController(max_tenant_rows=0),
+        index_capacity=16,
+    )
+    reg.add_model("exp", FakeEngine(scale=10.0))
+    reg.add_model("prod", FakeEngine(scale=1.0))
+    loads = []
+
+    def loader(name, ckpt):
+        loads.append((name, ckpt))
+        return FakeEngine(scale=5.0)
+
+    server = create_fleet_server(
+        reg, port=0, promote_loader=loader,
+        metrics_fn=fleet_metrics_fn(reg),
+    )
+    start_in_thread(server)
+    host, port = server.server_address[:2]
+    yield f"http://{host}:{port}", reg, loads
+    server.shutdown()
+    server.server_close()
+    reg.close()
+
+
+def test_http_embed_routes_and_defaults(fleet):
+    base, _, _ = fleet
+    x = imgs(3)
+    status, r = post(base, "/embed", {"images": x.tolist()})
+    assert status == 200 and r["model"] == "prod"  # newest added = default
+    np.testing.assert_allclose(r["embeddings"], FakeEngine(1.0).rows(x))
+    status, r = post(base, "/embed", {"images": x.tolist(), "model": "exp"})
+    assert r["model"] == "exp"
+    np.testing.assert_allclose(r["embeddings"], FakeEngine(10.0).rows(x))
+    assert r["dim"] == 3 and r["n"] == 1
+
+
+def test_http_unknown_model_and_bad_inputs_400(fleet):
+    base, _, _ = fleet
+    for body in (
+        {"images": imgs(1).tolist(), "model": "nope"},
+        {"images": imgs(1).tolist(), "model": 7},
+        {"images": [[1]]},
+        {"images": imgs(1).tolist(), "tenant": 3},
+    ):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post(base, "/embed", body)
+        assert exc.value.code == 400
+
+
+def test_http_neighbors_roundtrip(fleet):
+    base, reg, _ = fleet
+    corpus = imgs(10, 20, 30)
+    post(base, "/embed", {"images": corpus.tolist()})  # populates the index
+    status, r = post(base, "/neighbors", {"images": imgs(20).tolist(), "k": 2})
+    assert status == 200 and r["model"] == "prod" and r["k"] == 2
+    hits = r["neighbors"][0]
+    assert len(hits) == 2
+    assert hits[0]["id"] == reg.content_id(imgs(20)[0])  # self is top-1
+    assert hits[0]["score"] == pytest.approx(1.0, abs=1e-5)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        post(base, "/neighbors", {"images": imgs(1).tolist(), "k": 0})
+    assert exc.value.code == 400
+
+
+def test_http_promote_swaps_and_drains(fleet):
+    base, reg, loads = fleet
+    x = imgs(4)
+    status, r = post(base, "/models/promote", {"model": "prod", "ckpt": "/fake/ckpt"})
+    assert status == 200
+    assert r == {"model": "prod", "version": 2, "draining": 1}
+    assert loads == [("prod", "/fake/ckpt")]
+    assert reg.wait_drained("prod", 1, timeout=5)  # nothing was in flight
+    _, r = post(base, "/embed", {"images": x.tolist(), "model": "prod"})
+    np.testing.assert_allclose(r["embeddings"], FakeEngine(5.0).rows(x))
+    _, payload = get_raw(base, "/models")
+    models = json.loads(payload)["models"]
+    assert [v["state"] for v in models["prod"]["versions"]] == [
+        "retired", "serving",
+    ]
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        post(base, "/models/promote", {"model": "ghost", "ckpt": "/x"})
+    assert exc.value.code == 400
+
+
+def test_http_promote_without_loader_is_503():
+    reg = make_registry()
+    reg.add_model("m", FakeEngine())
+    server = create_fleet_server(reg, port=0)  # no promote_loader
+    start_in_thread(server)
+    host, port = server.server_address[:2]
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post(f"http://{host}:{port}", "/models/promote",
+                 {"model": "m", "ckpt": "/x"})
+        assert exc.value.code == 503
+    finally:
+        server.shutdown()
+        server.server_close()
+        reg.close()
+
+
+def test_http_metrics_exposition(fleet):
+    base, _, _ = fleet
+    post(base, "/embed", {"images": imgs(1).tolist()})
+    _, text = get_raw(base, "/metrics")
+    # the unlabeled aggregates the replica supervisor scrapes...
+    assert "\nserve_batcher_queue_depth " in "\n" + text
+    assert "serve_batcher_last_completion_age_s " in text
+    assert "serve_fleet_models 2" in text
+    # ...and the labeled per-model operator series
+    assert 'serve_fleet_model_serving_version{model="prod"} 1' in text
+    assert 'serve_fleet_index_entries{model="prod"} 1' in text
