@@ -248,6 +248,22 @@ CONFIGS = {
     "serve_fleet": dict(model=None, epochs=0, bar=None,
                         kind="serve_fleet_gate", dataset=None,
                         artifact="docs/evidence/serve_fleet_r17.json"),
+    # round 18: the retrieval-ladder gate. Binds on the COMMITTED brute-
+    # vs-IVF evidence artifact (docs/evidence/retrieval_ab_r18.json,
+    # produced by scripts/retrieval_ab.py sweeping 4k/64k/256k-row
+    # corpora): the pure retrieval_gate_record re-verifies EVERYWHERE
+    # that the brute rung answered bit-identically to the frozen PR-17
+    # scoring oracle (ids exact, float32 scores bitwise — the "brute
+    # path retained bit-for-bit" contract under --retrieval_impl) and
+    # that IVF recall@k cleared the artifact's recall bar on every rung
+    # (both are properties of the recorded answers, not the hardware).
+    # The >=5x p50 query-speedup claim at the top rung is CPU-calibrated
+    # and pass-skips off-CPU with the reason on record (the convblock
+    # convention). Re-produce the artifact with the A/B script when the
+    # retrieval surface changes; instant, so it rides the default list.
+    "retrieval_ab": dict(model=None, epochs=0, bar=None,
+                         kind="retrieval_gate", dataset=None,
+                         artifact="docs/evidence/retrieval_ab_r18.json"),
 }
 
 # CPU-calibrated bar for the health_report smoke's online probe: best
@@ -832,6 +848,74 @@ def serve_fleet_gate_record(artifact):
     return record
 
 
+def retrieval_gate_record(artifact):
+    """Gate decision for the brute-vs-IVF retrieval A/B evidence (pure —
+    tested without building an index).
+
+    Two claims bind on EVERY device (they are properties of the recorded
+    answers, not timings): the brute rung matched the frozen PR-17
+    scoring oracle bit-for-bit (ids exact AND float32 scores bitwise —
+    the contract that lets --retrieval_impl brute stay the recall
+    oracle), and IVF recall@k cleared the artifact's recall bar on every
+    rung. The p50 query-speedup claim at the top rung is CPU-calibrated
+    (single-row latency against the jitted brute scorer on host) and
+    pass-skips off-CPU with the reason on record (the convblock
+    convention)."""
+    summary = artifact.get("summary", {})
+    oracle = artifact.get("oracle", {})
+    record = {
+        "metric": "ratchet_retrieval_ab",
+        "value": summary.get("speedup_p50_max_rung"),
+        "min_recall_at_k": summary.get("min_recall_at_k"),
+        "max_rung_rows": summary.get("max_rung_rows"),
+        "oracle": oracle,
+        "device": artifact.get("device"),
+    }
+
+    def fail(msg):
+        record["ok"] = False
+        record["error"] = msg
+        return record
+
+    if artifact.get("schema") != "retrieval_ab/v1":
+        return fail(f"unexpected schema {artifact.get('schema')!r}")
+    rungs = artifact.get("rungs", [])
+    if len(rungs) < 2:
+        return fail("fewer than two corpus-size rungs in the artifact")
+    if not oracle.get("ids_identical"):
+        return fail("brute rung ids diverge from the PR-17 scoring oracle")
+    if not oracle.get("scores_bit_identical"):
+        return fail("brute rung scores are not bitwise-identical to the "
+                    "PR-17 scoring oracle")
+    if sorted(oracle.get("rungs_checked", [])) != sorted(
+        r["rows"] for r in rungs
+    ):
+        return fail("oracle bit-identity was not checked on every rung")
+    bar = summary.get("recall_bar")
+    if not bar:
+        return fail("artifact carries no recall bar")
+    low = [r["rows"] for r in rungs if r.get("recall_at_k", 0.0) < bar]
+    if low:
+        return fail(f"IVF recall@k under the {bar} bar at rungs {low}")
+    if artifact.get("device") != "cpu":
+        record["ok"] = True
+        record["skipped"] = (
+            f"device {artifact.get('device')!r}: p50 speedup claim "
+            "calibrated for CPU only; oracle bit-identity and recall "
+            "still enforced"
+        )
+        return record
+    speedup = summary.get("speedup_p50_max_rung")
+    speedup_bar = summary.get("speedup_bar", 5.0)
+    if speedup is None or speedup < speedup_bar:
+        return fail(
+            f"IVF p50 speedup {speedup} at the {summary.get('max_rung_rows')}"
+            f"-row rung under the {speedup_bar}x bar"
+        )
+    record["ok"] = True
+    return record
+
+
 def fleet_gate_record(artifact):
     """Gate decision for the fleet-merge evidence artifact (pure — tested
     without running a pod).
@@ -1355,6 +1439,24 @@ def run_config(name, spec, epochs, bar, args):
         print(json.dumps(record), flush=True)
         return record
 
+    if kind == "retrieval_gate":
+        # binds on the COMMITTED brute-vs-IVF A/B evidence (see the
+        # CONFIGS note): no subprocess — re-run scripts/retrieval_ab.py
+        # when the retrieval surface changes
+        path = os.path.join(REPO, spec["artifact"])
+        try:
+            with open(path) as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ConfigFailed(
+                f"no readable retrieval evidence at {path}: {e}"
+            ) from e
+        record = retrieval_gate_record(artifact)
+        record["bar"] = bar
+        record["artifact"] = spec["artifact"]
+        print(json.dumps(record), flush=True)
+        return record
+
     if kind == "ce":
         # the CE trainer end-to-end: train + validate in one driver
         # (protocol of docs/evidence/ce_30ep.log: rn50, lr 0.1 cosine, bf16)
@@ -1462,6 +1564,8 @@ def main():
                 metric = "ratchet_chaos_matrix"
             elif spec["kind"] == "serve_fleet_gate":
                 metric = "ratchet_serve_fleet"
+            elif spec["kind"] == "retrieval_gate":
+                metric = "ratchet_retrieval_ab"
             elif spec["kind"] == "fleet_report":
                 metric = "ratchet_fleet_report"
             elif spec["kind"] == "perf_ledger":

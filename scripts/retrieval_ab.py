@@ -1,0 +1,329 @@
+#!/usr/bin/env python
+"""Is the IVF rung sublinear where it claims to be — and at what recall?
+
+`serve/fleet/retrieval.py` (brute) scores every stored row per query:
+exact, O(capacity * dim), fine at the 4096-row default. `serve/fleet/ivf.py`
+claims O(nlist * dim + nprobe * avg_list_len * dim) by probing only the
+``nprobe`` nearest of its self-trained k-means lists — at the price of a
+measurable recall@k against the exact answer. This script MEASURES both
+sides of that trade across corpus-size rungs (4k/64k/256k full; tiny under
+``--smoke``), the repo's paired-A/B way:
+
+- one corpus per rung, cluster-structured (centers + Gaussian noise — the
+  regime served embeddings actually live in; isotropic noise would make
+  ANY coarse quantizer look bad and no real corpus look like it), inserted
+  into BOTH indexes in the same chunked order with the same content keys;
+- **brute-oracle bit-identity before any timing**: the brute rung's
+  answers are compared against a frozen numpy restatement of the PR-17
+  scoring contract (L2-normalize on insert and query, score = unit-dot,
+  argpartition + stable argsort top-k) — ids must match exactly and
+  scores must match BITWISE (float32). This is the "brute path retained
+  bit-for-bit" contract: it gates the artifact and binds on every device;
+- **recall@k** = |IVF top-k  ∩  brute top-k| / k per query, averaged — the
+  brute arm IS the oracle for the IVF arm;
+- timing is per-query wall time over single-row queries (the /neighbors
+  shape), arm order ABBA within every round after one full discarded warm
+  arm of EACH kind (the warm brute arm also absorbs the one
+  H2D-per-mutation-burst upload; the warm IVF arm builds the probed
+  lists' cached matrices), p50/p99 pooled per arm per rung. Results come
+  back as host floats, so every timed query is already synced — the
+  honest-sync rule is structural here.
+
+The committed artifact is docs/evidence/retrieval_ab_r18.json; the
+``retrieval_ab`` config in scripts/ratchet.py's DEFAULT list re-verifies
+it (recall bar + bit-identity everywhere; the >=5x p50 speedup claim at
+the top rung is CPU-calibrated and pass-skips off-CPU).
+
+Usage: python scripts/retrieval_ab.py [--smoke] [--json OUT]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simclr_pytorch_distributed_tpu.serve.fleet.ivf import (  # noqa: E402
+    IVFIndex,
+    auto_nlist,
+)
+from simclr_pytorch_distributed_tpu.serve.fleet.retrieval import (  # noqa: E402
+    NeighborIndex,
+)
+
+SCHEMA = "retrieval_ab/v1"
+ARM_ORDER = ("brute", "ivf", "ivf", "brute")  # ABBA within every round
+RECALL_BAR = 0.95
+SPEEDUP_BAR = 5.0
+INSERT_CHUNK = 8192  # /embed-burst-sized add() calls, same order both arms
+
+
+def brute_oracle(corpus_unit, q_unit, k):
+    """Frozen numpy restatement of the PR-17 brute scoring contract, for
+    the bit-identity check: unit-dot scores over the corpus in slot order
+    (insertion order — no eviction at capacity == rows), argpartition +
+    stable argsort top-k. Deliberately NOT a call into the index."""
+    scores = (q_unit @ corpus_unit.T).astype(np.float32, copy=False)
+    out = []
+    for row_scores in scores:
+        k_eff = min(int(k), row_scores.shape[0])
+        top = np.argpartition(-row_scores, k_eff - 1)[:k_eff]
+        top = top[np.argsort(-row_scores[top], kind="stable")]
+        out.append([(int(i), np.float32(row_scores[i])) for i in top])
+    return out
+
+
+def unit_rows(rows):
+    rows = np.asarray(rows, np.float32)
+    norms = np.linalg.norm(rows, axis=-1, keepdims=True)
+    return rows / np.maximum(norms, 1e-12)
+
+
+def percentile(values, p):
+    return float(np.percentile(np.asarray(values, np.float64), p))
+
+
+def build_output(device, params, rungs, oracle):
+    """Assemble the committed artifact from per-rung records (pure, so
+    tests pin the schema without running the measurement).
+
+    ``rungs``: one dict per corpus size with the paired latency runs,
+    pooled quantiles, recall, and index stats. ``oracle``: the brute
+    bit-identity record."""
+    per_rung = [
+        {
+            "rows": r["rows"],
+            "recall_at_k": r["recall_at_k"],
+            "speedup_p50": r["speedup_p50"],
+            "brute_p50_ms": r["lat_ms"]["brute"]["p50"],
+            "ivf_p50_ms": r["lat_ms"]["ivf"]["p50"],
+            "brute_p99_ms": r["lat_ms"]["brute"]["p99"],
+            "ivf_p99_ms": r["lat_ms"]["ivf"]["p99"],
+        }
+        for r in rungs
+    ]
+    top = max(rungs, key=lambda r: r["rows"])
+    return {
+        "schema": SCHEMA,
+        "metric": "retrieval_query_ms",
+        "params": params,
+        "arm_order": "ABBA per round: " + ",".join(ARM_ORDER),
+        "rungs": rungs,
+        "oracle": oracle,
+        "summary": {
+            "recall_bar": RECALL_BAR,
+            "speedup_bar": SPEEDUP_BAR,
+            "min_recall_at_k": min(r["recall_at_k"] for r in rungs),
+            "max_rung_rows": top["rows"],
+            "speedup_p50_max_rung": top["speedup_p50"],
+            "per_rung": per_rung,
+        },
+        "device": device,
+        "note": (
+            "paired brute-vs-IVF /neighbors A/B over cluster-structured "
+            "corpora: same rows, same content keys, same chunked insert "
+            "order into both indexes; per-query single-row latency, ABBA "
+            "arm order after one discarded warm arm of each kind; "
+            "recall@k counts IVF hits against the brute top-k (the brute "
+            "arm is the oracle); the brute arm itself is bit-checked "
+            "(ids exact, float32 scores bitwise) against a frozen numpy "
+            "restatement of the PR-17 scoring contract before any timing; "
+            "query results are host floats, so every timed call is synced "
+            "by construction"
+        ),
+    }
+
+
+def main(argv=None):
+    def positive_int(s):
+        v = int(s)
+        if v < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+        return v
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", default=None,
+                    help="comma-separated corpus-size rungs; default "
+                         "4096,65536,262144 (1024,4096 under --smoke)")
+    ap.add_argument("--dim", type=positive_int, default=None,
+                    help="embedding dim; default 64 (16 under --smoke)")
+    ap.add_argument("--k", type=positive_int, default=10,
+                    help="neighbors per query (recall is recall@k)")
+    ap.add_argument("--queries", type=positive_int, default=None,
+                    help="queries per timed arm run; default 32 (8 under "
+                         "--smoke)")
+    ap.add_argument("--rounds", type=positive_int, default=None,
+                    help="ABBA rounds (2 measurements per arm per round); "
+                         "default 2 (1 under --smoke)")
+    ap.add_argument("--nlist", type=int, default=0,
+                    help="IVF lists; 0 = sqrt(rows) per rung, clamped")
+    ap.add_argument("--nprobe", type=positive_int, default=8,
+                    help="IVF lists scanned per query")
+    ap.add_argument("--noise", type=float, default=0.25,
+                    help="cluster noise sigma (rows = center + sigma*N(0,1))")
+    ap.add_argument("--seed", type=positive_int, default=7)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU config for tests")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+    # --smoke fills only flags the caller left unset (flush_ab pattern)
+    smoke_defaults = dict(rows="1024,4096", dim=16, queries=8, rounds=1)
+    full_defaults = dict(rows="4096,65536,262144", dim=64, queries=32,
+                         rounds=2)
+    for key, v in (smoke_defaults if args.smoke else full_defaults).items():
+        if getattr(args, key) is None:
+            setattr(args, key, v)
+    rung_rows = [positive_int(s) for s in args.rows.split(",")]
+
+    import jax  # late: everything here is host numpy except brute's scorer
+
+    device = jax.devices()[0].device_kind
+    rungs = []
+    oracle = {
+        "ids_identical": True,
+        "scores_bit_identical": True,
+        "queries_checked": 0,
+        "rungs_checked": [],
+    }
+
+    for rows_n in rung_rows:
+        rng = np.random.default_rng((args.seed, rows_n))
+        # cluster-structured corpus: served-embedding-like geometry
+        n_clusters = max(16, rows_n // 512)
+        centers = rng.standard_normal((n_clusters, args.dim)).astype(np.float32)
+        which = rng.integers(0, n_clusters, rows_n)
+        corpus = (
+            centers[which]
+            + args.noise * rng.standard_normal((rows_n, args.dim))
+        ).astype(np.float32)
+        keys = [f"r{i:07d}" for i in range(rows_n)]
+        q = (
+            centers[rng.integers(0, n_clusters, args.queries)]
+            + args.noise
+            * rng.standard_normal((args.queries, args.dim))
+        ).astype(np.float32)
+
+        nlist = args.nlist or auto_nlist(rows_n)
+        brute = NeighborIndex(args.dim, capacity=rows_n)
+        ivf = IVFIndex(args.dim, capacity=rows_n, nlist=nlist,
+                       nprobe=args.nprobe, seed=args.seed)
+        insert_ms = {}
+        for arm, index in (("brute", brute), ("ivf", ivf)):
+            t0 = time.perf_counter()
+            for lo in range(0, rows_n, INSERT_CHUNK):
+                index.add(keys[lo:lo + INSERT_CHUNK],
+                          corpus[lo:lo + INSERT_CHUNK])
+            insert_ms[arm] = round((time.perf_counter() - t0) * 1e3, 2)
+
+        # ---- brute bit-identity vs the frozen oracle (gates the artifact,
+        # before any timing) --------------------------------------------
+        corpus_unit = unit_rows(corpus)
+        q_unit = unit_rows(q)
+        expected = brute_oracle(corpus_unit, q_unit, args.k)
+        got = brute.query(q, args.k)
+        for exp_row, got_row in zip(expected, got):
+            exp_ids = [keys[i] for i, _ in exp_row]
+            if exp_ids != [key for key, _ in got_row]:
+                oracle["ids_identical"] = False
+            if any(
+                np.float32(score).tobytes() != exp_score.tobytes()
+                for (_, exp_score), (_, score) in zip(exp_row, got_row)
+            ):
+                oracle["scores_bit_identical"] = False
+        oracle["queries_checked"] += len(expected)
+        oracle["rungs_checked"].append(rows_n)
+        if not (oracle["ids_identical"] and oracle["scores_bit_identical"]):
+            print(json.dumps({"oracle": oracle}), flush=True)
+            raise SystemExit(
+                f"brute rung diverged from the PR-17 oracle at {rows_n} rows"
+            )
+
+        # ---- recall@k: IVF against the brute answer ---------------------
+        brute_top = [set(key for key, _ in row) for row in got]
+        ivf_top = ivf.query(q, args.k)
+        recall = float(np.mean([
+            len(b & set(key for key, _ in v)) / max(1, len(b))
+            for b, v in zip(brute_top, ivf_top)
+        ]))
+
+        # ---- timing: per-query latency, ABBA after discarded warms ------
+        def run_arm(index):
+            lats = []
+            for row in q:
+                t0 = time.perf_counter()
+                res = index.query(row[None, :], args.k)
+                lats.append((time.perf_counter() - t0) * 1e3)
+            assert res[0] and np.isfinite(res[0][0][1])
+            return lats
+
+        arms = {"brute": brute, "ivf": ivf}
+        warm = {arm: round(percentile(run_arm(index), 50), 4)
+                for arm, index in arms.items()}
+        print(json.dumps({"rows": rows_n,
+                          "warmup_discarded_p50_ms": warm}), flush=True)
+        pooled = {"brute": [], "ivf": []}
+        runs = []
+        for rnd in range(args.rounds):
+            record = {"brute": [], "ivf": []}
+            for arm in ARM_ORDER:
+                lats = run_arm(arms[arm])
+                pooled[arm].extend(lats)
+                record[arm].append(round(percentile(lats, 50), 4))
+            runs.append(record)
+            print(json.dumps({"rows": rows_n, "round": rnd,
+                              "p50_ms": record}), flush=True)
+
+        lat_ms = {
+            arm: {
+                "p50": round(percentile(vals, 50), 4),
+                "p99": round(percentile(vals, 99), 4),
+                "n": len(vals),
+            }
+            for arm, vals in pooled.items()
+        }
+        ivf_stats = ivf.stats()
+        rung = {
+            "rows": rows_n,
+            "clusters": n_clusters,
+            "nlist": nlist,
+            "nprobe": args.nprobe,
+            "insert_ms": insert_ms,
+            "runs": runs,
+            "lat_ms": lat_ms,
+            "recall_at_k": round(recall, 4),
+            "speedup_p50": (
+                round(lat_ms["brute"]["p50"] / lat_ms["ivf"]["p50"], 3)
+                if lat_ms["ivf"]["p50"] > 0 else None
+            ),
+            "ivf_stats": {
+                key: ivf_stats[key]
+                for key in ("trained_lists", "retrains", "probes",
+                            "evictions", "entries")
+            },
+        }
+        rungs.append(rung)
+        print(json.dumps({"rung": {
+            "rows": rows_n, "recall_at_k": rung["recall_at_k"],
+            "speedup_p50": rung["speedup_p50"], "lat_ms": lat_ms,
+        }}), flush=True)
+
+    params = {
+        "dim": args.dim, "k": args.k, "queries": args.queries,
+        "rounds": args.rounds, "nlist": args.nlist, "nprobe": args.nprobe,
+        "noise": args.noise, "seed": args.seed, "smoke": bool(args.smoke),
+        "insert_chunk": INSERT_CHUNK,
+    }
+    out = build_output(device, params, rungs, oracle)
+    print(json.dumps(out))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -30,7 +30,10 @@ checkpoint versions hosted behind one ``ModelRegistry`` (serve/fleet/),
 driven by a skewed tenant mix (a bulk tenant hammering the default model,
 an interactive tenant on the canary) — per-model throughput/latency plus
 the admission-controller counters land in the artifact under
-``multi_model``.
+``multi_model`` — and a **retrieval arm**: closed-loop ``/neighbors``
+under mixed ``/embed`` load, run once per ``--retrieval_impl`` rung
+(brute :class:`NeighborIndex` vs :class:`IVFIndex`) on the same workload
+stream, per-impl query latency and index counters under ``retrieval``.
 
 ``--smoke`` is the CI end-to-end proof (tests/test_scripts.py): tiny
 random-init model on CPU, a short closed + open loop through the REAL
@@ -451,6 +454,101 @@ def multi_model_arm(args, rng, sizes):
         registry.close()
 
 
+def retrieval_arm(args, rng, sizes):
+    """Closed-loop /neighbors under mixed /embed load, once per retrieval
+    impl: a single-model registry whose index is the brute
+    :class:`NeighborIndex` on one arm and :class:`IVFIndex` on the other,
+    driven by the SAME workload stream (same arm seed -> identical images,
+    sizes, and query schedule). Every request embeds through the real
+    batcher and feeds the index (the /embed server path); every second
+    request then doubles as a /neighbors client, timing only the
+    ``neighbors_lookup`` — the number the impl ladder actually changes.
+    Reports per-impl embed/query latency plus the index counters, and the
+    brute/ivf query-p50 ratio the sweep artifact pins.
+
+    Closed-loop for the same reason as :func:`multi_model_arm`: one
+    compiled program in flight at a time keeps the CPU backend's
+    collective rendezvous off the table."""
+    from simclr_pytorch_distributed_tpu.serve.fleet import (
+        AdmissionController,
+        ModelRegistry,
+    )
+    from simclr_pytorch_distributed_tpu.serve.fleet import ivf as ivf_mod
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    capacity = 4096
+    # small lists + a low train floor so the IVF arm reaches the TRAINED
+    # path even at smoke row counts (~dozens of rows), not just the
+    # provisional single-list rung
+    nlist, nprobe, train_min_rows = 8, 4, 32
+    arms = {}
+    for impl in ("brute", "ivf"):
+        factory = None
+        if impl == "ivf":
+            factory = lambda dim: ivf_mod.IVFIndex(  # noqa: E731
+                dim, capacity=capacity, nlist=nlist, nprobe=nprobe,
+                seed=args.seed, train_min_rows=train_min_rows,
+            )
+        registry = ModelRegistry(
+            batcher_kwargs=dict(
+                max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+                max_queue=args.max_queue, max_inflight=args.max_inflight,
+                max_inflight_images=args.max_inflight_images,
+            ),
+            admission=AdmissionController(max_tenant_rows=0),
+            index_capacity=capacity,
+            index_factory=factory,
+        )
+        try:
+            engine = EmbeddingEngine.random_init(
+                model_name=args.model, size=args.img_size, seed=args.seed,
+                buckets=buckets, img_size=args.img_size, dtype=args.dtype,
+            )
+            for b in buckets:
+                engine.embed(make_images(rng, b, args.img_size))
+            registry.add_model("prod", engine)
+
+            # one rng per arm, same seed: both impls see the same workload
+            arm_rng = np.random.default_rng(args.seed + 17)
+            embed_lat, query_lat = [], []
+            for i in range(args.sweep_requests):
+                n = int(arm_rng.choice(sizes))
+                images = make_images(arm_rng, n, args.img_size)
+                t0 = time.perf_counter()
+                name, fut = registry.submit(
+                    images, model="prod", tenant="bench"
+                )
+                emb = fut.result(timeout=120)
+                embed_lat.append((time.perf_counter() - t0) * 1e3)
+                registry.index_add(name, images, emb)
+                if i % 2 == 1:
+                    t0 = time.perf_counter()
+                    registry.neighbors_lookup(name, emb[:1], 5)
+                    query_lat.append((time.perf_counter() - t0) * 1e3)
+            index_stats = registry.stats()["models"]["prod"]["index"]
+            arms[impl] = {
+                "requests": args.sweep_requests,
+                "neighbors_queries": len(query_lat),
+                "embed_latency": percentiles(embed_lat),
+                "query_latency": percentiles(query_lat),
+                "index": index_stats,
+            }
+        finally:
+            registry.close()
+    brute_p50 = (arms["brute"]["query_latency"] or {}).get("p50_ms")
+    ivf_p50 = (arms["ivf"]["query_latency"] or {}).get("p50_ms")
+    return {
+        "capacity": capacity,
+        "nlist": nlist,
+        "nprobe": nprobe,
+        "k": 5,
+        "per_impl": arms,
+        "query_p50_ratio_brute_over_ivf": (
+            round(brute_p50 / ivf_p50, 3) if brute_p50 and ivf_p50 else None
+        ),
+    }
+
+
 def cache_pass(batcher, engine, rng, size):
     """Submit the SAME images twice; the second pass must be answered from
     the cache (hits recorded, no new engine dispatches)."""
@@ -605,6 +703,7 @@ def main(argv=None):
             ),
             "http": http_result,
             "multi_model": multi_model_arm(args, rng, sizes),
+            "retrieval": retrieval_arm(args, rng, sizes),
             "engine_stats": engine.stats(),
             "device": str(engine.mesh.devices.flat[0].device_kind),
         }

@@ -16,19 +16,34 @@ into that:
   retired version's rows;
 - :mod:`retrieval` — :class:`NeighborIndex`: bounded, content-keyed,
   LRU-evicted store of served embeddings with an on-device brute-force
-  cosine scorer — the ``/neighbors`` endpoint's substrate;
+  cosine scorer — the ``/neighbors`` endpoint's exact small-corpus rung
+  and the recall oracle for everything above it;
+- :mod:`ivf` — :class:`IVFIndex`: the sublinear rung — self-trained
+  k-means coarse quantizer over the stored rows, per-centroid inverted
+  lists (per-list LRU under the global budget), exact cosine over only
+  the ``nprobe`` nearest lists. ``resolve_retrieval_impl`` is the
+  ``--retrieval_impl {brute,ivf,auto}`` ladder both the frontend CLI and
+  the bench resolve through;
 - :mod:`frontend` — the HTTP surface: ``/embed`` with model routing,
-  ``/models/promote``, ``/neighbors``, ``/models``, and a ``/metrics``
-  exposition whose unlabeled gauges the replica-fleet supervisor
-  (supervise/replica_fleet.py) scrapes. ``python -m
+  ``/models/promote``, ``/neighbors`` (``k`` bounded by
+  ``--neighbors_max_k``), ``/models``, and a ``/metrics`` exposition
+  whose unlabeled gauges the replica-fleet supervisor
+  (supervise/replica_fleet.py) scrapes, plus per-model labeled retrieval
+  gauges (entries/inserts/evictions/queries/probes/retrains). ``python -m
   simclr_pytorch_distributed_tpu.serve.fleet`` serves it.
 
 Evidence: the end-to-end multi-process scenario (spawn -> saturate ->
 restart a killed replica -> promote under load -> drain) is
 ``scripts/serve_fleet_scenario.py``, committed as
-``docs/evidence/serve_fleet_r17.json`` and gated by ``scripts/ratchet.py``.
+``docs/evidence/serve_fleet_r17.json``; the brute-vs-IVF latency/recall
+A/B is ``scripts/retrieval_ab.py``, committed as
+``docs/evidence/retrieval_ab_r18.json``. Both gate in ``scripts/ratchet.py``.
 """
 
+from simclr_pytorch_distributed_tpu.serve.fleet.ivf import (  # noqa: F401
+    IVFIndex,
+    resolve_retrieval_impl,
+)
 from simclr_pytorch_distributed_tpu.serve.fleet.registry import (  # noqa: F401
     AdmissionController,
     ModelRegistry,

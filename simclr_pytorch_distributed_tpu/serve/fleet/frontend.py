@@ -13,7 +13,10 @@ The wire contract extends serve/server.py (payloads are byte-compatible —
   proves it). Replies the new version and which version is draining.
 - ``POST /neighbors`` — ``{"images": ..., "k": 5, "model": ...}``: embed
   the query images through the SAME batcher/admission path as /embed, then
-  return top-k ``{"id", "score"}`` neighbors from the model's index.
+  return top-k ``{"id", "score"}`` neighbors from the model's index
+  (brute or IVF per the ``--retrieval_impl`` ladder; ``k`` above
+  ``--neighbors_max_k`` is 400 — the index answers ``min(k, entries)``,
+  so an unbounded ``k`` would dump the whole index).
 - ``GET /models`` — the routing table (names, versions, drain states).
 - ``GET /healthz``, ``/stats``, ``/metrics`` — as the single-model server;
   /metrics aggregates the per-model batchers into the UNLABELED gauges the
@@ -35,6 +38,7 @@ from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from simclr_pytorch_distributed_tpu.serve.batcher import QueueFull, RequestTimeout
+from simclr_pytorch_distributed_tpu.serve.fleet import ivf
 from simclr_pytorch_distributed_tpu.serve.fleet.registry import (
     AdmissionController,
     ModelRegistry,
@@ -48,18 +52,27 @@ from simclr_pytorch_distributed_tpu.serve.server import (
 logger = logging.getLogger(__name__)
 
 
+DEFAULT_NEIGHBORS_MAX_K = 100
+
+
 def make_fleet_handler(
     registry: ModelRegistry,
     *,
     result_timeout_s: float = 30.0,
     promote_loader=None,
     metrics_fn=None,
+    neighbors_max_k: int = DEFAULT_NEIGHBORS_MAX_K,
 ):
     """Request-handler class over one registry.
 
     ``promote_loader`` is ``(name, ckpt) -> engine`` — injectable so tests
     promote fake engines without checkpoints on disk; absent, /models/promote
     answers 503 (a frontend that cannot load has no business swapping).
+
+    ``neighbors_max_k`` bounds the client-chosen ``k`` on /neighbors
+    (0 disables the bound): the index answers ``min(k, entries)``, so an
+    unbounded ``k`` lets any client dump the ENTIRE index contents — and
+    pay an index-sized response — with one request.
     """
 
     class Handler(BaseHTTPRequestHandler):
@@ -171,6 +184,11 @@ def make_fleet_handler(
                     k = payload.get("k", 5)
                     if not isinstance(k, int) or isinstance(k, bool) or k < 1:
                         raise ValueError(f"k must be a positive int, got {k!r}")
+                    if neighbors_max_k and k > neighbors_max_k:
+                        raise ValueError(
+                            f"k={k} exceeds the --neighbors_max_k bound "
+                            f"({neighbors_max_k})"
+                        )
                     hits = registry.neighbors_lookup(name, emb, k)
                     self._reply(200, {
                         "model": name,
@@ -227,10 +245,12 @@ def make_fleet_handler(
 def create_fleet_server(
     registry: ModelRegistry, host: str = "127.0.0.1", port: int = 8000,
     result_timeout_s: float = 30.0, promote_loader=None, metrics_fn=None,
+    neighbors_max_k: int = DEFAULT_NEIGHBORS_MAX_K,
 ) -> ThreadingHTTPServer:
     handler = make_fleet_handler(
         registry, result_timeout_s=result_timeout_s,
         promote_loader=promote_loader, metrics_fn=metrics_fn,
+        neighbors_max_k=neighbors_max_k,
     )
     server = ThreadingHTTPServer((host, port), handler)
     server.daemon_threads = True
@@ -278,10 +298,21 @@ def fleet_metrics_fn(registry: ModelRegistry, latency=None):
                 entry["serving"],
             ))
             if "index" in entry:
-                samples.append((
-                    "serve_fleet_index_entries", {"model": name},
-                    entry["index"]["entries"],
-                ))
+                idx = entry["index"]
+                # the full retrieval surface, per model: corpus size, LRU
+                # churn, query volume, and the IVF probe/retrain counters
+                # (0 on the brute rung — a recall degradation with the
+                # retrain counter STUCK is the quantizer-drift failure
+                # trail, docs/OBSERVABILITY.md)
+                for gauge, key in (
+                    ("serve_fleet_index_entries", "entries"),
+                    ("serve_fleet_index_inserts_total", "inserts"),
+                    ("serve_fleet_index_evictions_total", "evictions"),
+                    ("serve_fleet_index_queries_total", "queries"),
+                    ("serve_fleet_index_probes_total", "probes"),
+                    ("serve_fleet_index_retrains_total", "retrains"),
+                ):
+                    samples.append((gauge, {"model": name}, idx.get(key, 0)))
         for key in SUM_KEYS:
             samples.append((f"serve_batcher_{key}", None, agg[key]))
         samples.append(("serve_batcher_pipeline_occupancy", None, occ))
@@ -316,6 +347,24 @@ def build_parser():
     p.add_argument("--index_capacity", type=int, default=4096,
                    help="per-model retrieval index rows (LRU-evicted); "
                         "0 disables /neighbors")
+    p.add_argument("--retrieval_impl", default="auto",
+                   choices=("brute", "ivf", "auto"),
+                   help="/neighbors index implementation (the --loss_impl "
+                        "ladder): brute = exact cosine over every row, "
+                        "ivf = k-means inverted lists scanning only "
+                        "--ivf_nprobe of them, auto = ivf above a "
+                        "corpus-size threshold")
+    p.add_argument("--ivf_nlist", type=int, default=0,
+                   help="IVF coarse-quantizer centroids; 0 = "
+                        "sqrt(index_capacity), clamped")
+    p.add_argument("--ivf_nprobe", type=int, default=ivf.DEFAULT_NPROBE,
+                   help="IVF lists scanned per query: the recall/latency "
+                        "dial (docs/SERVING.md)")
+    p.add_argument("--neighbors_max_k", type=int,
+                   default=DEFAULT_NEIGHBORS_MAX_K,
+                   help="reject /neighbors k above this with 400 (the "
+                        "index answers min(k, entries), so an unbounded k "
+                        "dumps the whole index); 0 disables the bound")
     p.add_argument("--tenant_quota_rows", type=int, default=0,
                    help="admission control: max outstanding rows per "
                         "(model, tenant); 0 disables the layer")
@@ -326,6 +375,7 @@ def build_fleet_stack(args):
     """Registry + initial model + HTTP server from parsed args — the fleet
     analogue of serve.server.build_stack, split out so tests and the bench
     drive the exact CLI stack without serve_forever."""
+    from simclr_pytorch_distributed_tpu import config
     from simclr_pytorch_distributed_tpu.serve.cache import EmbeddingCache
     from simclr_pytorch_distributed_tpu.serve.engine import EmbeddingEngine
     from simclr_pytorch_distributed_tpu.utils import prom
@@ -348,6 +398,22 @@ def build_fleet_stack(args):
     def loader(name, ckpt):
         return EmbeddingEngine.from_checkpoint(ckpt, **engine_kwargs(name))
 
+    # the --retrieval_impl ladder (the --loss_impl/--conv_impl convention):
+    # resolve ONCE at startup, honored-or-raise for explicit asks, and say
+    # why in the banner — the impl decides every /neighbors latency number
+    impl, reason = ivf.resolve_retrieval_impl(
+        args.retrieval_impl, args.index_capacity, args.ivf_nlist
+    )
+    logging.info(config.impl_resolution_banner(
+        "retrieval_impl", args.retrieval_impl, impl, reason
+    ))
+    index_factory = None
+    if impl == "ivf":
+        index_factory = lambda dim: ivf.IVFIndex(  # noqa: E731
+            dim, capacity=args.index_capacity, nlist=args.ivf_nlist,
+            nprobe=args.ivf_nprobe,
+        )
+
     latency = prom.LatencyHistogram()
     registry = ModelRegistry(
         batcher_kwargs=dict(
@@ -357,6 +423,7 @@ def build_fleet_stack(args):
         ),
         admission=AdmissionController(args.tenant_quota_rows),
         index_capacity=args.index_capacity,
+        index_factory=index_factory,
     )
     if args.ckpt:
         engine = loader(args.name, args.ckpt)
@@ -370,6 +437,7 @@ def build_fleet_stack(args):
     server = create_fleet_server(
         registry, host=args.host, port=args.port, promote_loader=loader,
         metrics_fn=fleet_metrics_fn(registry, latency),
+        neighbors_max_k=args.neighbors_max_k,
     )
     return registry, server
 

@@ -186,6 +186,7 @@ class ModelRegistry:
         batcher_kwargs: Optional[dict] = None,
         admission: Optional[AdmissionController] = None,
         index_capacity: int = 4096,
+        index_factory: Optional[Callable[[int], object]] = None,
     ):
         # one lock orders every routing/promote/drain transition; engine
         # dispatches run OUTSIDE it (they take the engine's own lock and
@@ -197,6 +198,10 @@ class ModelRegistry:
         self._batcher_kwargs = dict(batcher_kwargs or {})
         self.admission = admission if admission is not None else AdmissionController()
         self._index_capacity = int(index_capacity)
+        # feat_dim -> index; the registry is impl-blind — the frontend's
+        # --retrieval_impl ladder decides brute vs IVF (serve/fleet/ivf.py)
+        # and hands the constructor down here
+        self._index_factory = index_factory
         self._closed = False
 
     # ----------------------------------------------------------- lifecycle
@@ -205,10 +210,12 @@ class ModelRegistry:
         """Host a new NAME at version 1 and make it the default route."""
         mv = ModelVersion(name, 1, engine, source)
         engine.set_identity(mv.identity)
-        index = (
-            NeighborIndex(engine.feat_dim, capacity=self._index_capacity)
-            if self._index_capacity > 0 else None
-        )
+        if self._index_capacity <= 0:
+            index = None
+        elif self._index_factory is not None:
+            index = self._index_factory(engine.feat_dim)
+        else:
+            index = NeighborIndex(engine.feat_dim, capacity=self._index_capacity)
         batcher = DynamicBatcher(
             dispatch_fn=lambda images, _n=name: self._dispatch(_n, images),
             # both closures track the CURRENT serving version: a promote

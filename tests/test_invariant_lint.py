@@ -458,10 +458,10 @@ def test_ratchet_default_list_includes_lint_gate():
 def test_committed_evidence_passes_gate():
     """The committed docs/evidence artifact re-verifies under the pure
     gate record — the acceptance-criteria bind."""
-    # r17: regenerated after serve/fleet/, supervise/replica*.py, and
-    # scripts/serve_fleet_scenario.py joined the scanned surface (99
-    # files; the serving-fleet round)
-    path = os.path.join(REPO, "docs", "evidence", "invariant_lint_r17.json")
+    # r18: regenerated after serve/fleet/ivf.py and
+    # scripts/retrieval_ab.py joined the scanned surface (101 files; the
+    # IVF retrieval round)
+    path = os.path.join(REPO, "docs", "evidence", "invariant_lint_r18.json")
     with open(path) as f:
         artifact = json.load(f)
     ratchet = _ratchet()

@@ -1,0 +1,314 @@
+"""IVFIndex lifecycle-edge tests: the untrained rung IS brute force, the
+trained rung's recall on clustered corpora, seeded determinism, per-list
+LRU eviction under the global budget (with an nprobe=nlist exactness
+oracle that survives churn and retrains), the promote-clear seam under
+concurrent queries, concurrent add/query/retrain threads, and the
+``--retrieval_impl`` ladder resolution. Pure numpy — no jax compiles:
+the IVF rung is deliberately host-side (see ivf.py's docstring), so the
+whole file runs at unit-test speed.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from simclr_pytorch_distributed_tpu.serve.fleet.ivf import (
+    AUTO_IVF_MIN_CAPACITY,
+    IVFIndex,
+    auto_nlist,
+    resolve_retrieval_impl,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.servefleet]
+
+
+def unit(rows):
+    rows = np.asarray(rows, np.float32)
+    return rows / np.maximum(
+        np.linalg.norm(rows, axis=-1, keepdims=True), 1e-12
+    )
+
+
+def clustered(rng, n, dim, n_clusters=8, noise=0.25):
+    """The regime served embeddings live in: points scattered around a few
+    directions, not isotropic noise (where no quantizer could help)."""
+    centers = unit(rng.normal(size=(n_clusters, dim)))
+    rows = centers[rng.integers(0, n_clusters, size=n)]
+    return (rows + noise * rng.normal(size=(n, dim))).astype(np.float32)
+
+
+def brute_ids(corpus_unit, keys, q, k):
+    scores = corpus_unit @ unit(q)
+    order = np.argsort(-scores, kind="stable")[:k]
+    return [keys[i] for i in order]
+
+
+# ------------------------------------------------------------- exactness
+
+
+def test_untrained_ivf_is_exact_brute():
+    """Below train_min_rows there is one provisional list and a query
+    scans it exactly — answers match the brute oracle including order."""
+    rng = np.random.default_rng(0)
+    rows = rng.normal(size=(40, 16)).astype(np.float32)
+    keys = [f"k{i}" for i in range(40)]
+    index = IVFIndex(16, capacity=64, nlist=8, train_min_rows=1000)
+    index.add(keys, rows)
+    assert index.stats()["trained_lists"] == 0
+
+    corpus = unit(rows)
+    for q in rng.normal(size=(5, 16)).astype(np.float32):
+        got = index.query(q[None], k=7)[0]
+        assert [key for key, _ in got] == brute_ids(corpus, keys, q, 7)
+        oracle_scores = np.sort(corpus @ unit(q))[::-1][:7]
+        np.testing.assert_allclose(
+            [s for _, s in got], oracle_scores, rtol=1e-5, atol=1e-6
+        )
+
+
+def test_trained_recall_on_clustered_corpus():
+    rng = np.random.default_rng(1)
+    dim, n, k = 16, 2000, 10
+    rows = clustered(rng, n, dim, n_clusters=16)
+    keys = [f"k{i}" for i in range(n)]
+    index = IVFIndex(dim, capacity=4096, nlist=16, nprobe=8,
+                     train_min_rows=256)
+    index.add(keys, rows)
+    s = index.stats()
+    assert s["trained_lists"] == 16 and s["retrains"] >= 1
+
+    corpus = unit(rows)
+    queries = rows[rng.choice(n, size=20, replace=False)]
+    queries = queries + 0.1 * rng.normal(size=queries.shape).astype(np.float32)
+    hits = total = 0
+    for q in queries.astype(np.float32):
+        got = {key for key, _ in index.query(q[None], k=k)[0]}
+        hits += len(got & set(brute_ids(corpus, keys, q, k)))
+        total += k
+    assert hits / total >= 0.9
+    assert index.stats()["probes"] >= 8 * len(queries)
+
+
+def test_determinism_same_seed_same_order():
+    """Same seed + same insert order -> identical centroids, lists, and
+    answers (the property the committed A/B artifact leans on)."""
+    rng = np.random.default_rng(2)
+    rows = clustered(rng, 600, 8)
+    keys = [f"k{i}" for i in range(600)]
+    queries = rng.normal(size=(8, 8)).astype(np.float32)
+
+    answers = []
+    for _ in range(2):
+        index = IVFIndex(8, capacity=1024, nlist=8, nprobe=2, seed=3,
+                         train_min_rows=128)
+        index.add(keys, rows)
+        answers.append([index.query(q[None], k=5)[0] for q in queries])
+    assert answers[0] == answers[1]  # keys AND float scores, exactly
+
+
+# ------------------------------------------------------ eviction / recency
+
+
+def test_per_list_lru_global_budget_and_churn_exactness():
+    """Churn 3x the capacity through a trained index: the global budget
+    holds, evictions are counted, and — with nprobe=nlist so every list
+    is probed — answers over the SURVIVING corpus stay EXACTLY brute
+    (recall invariance under churn is not a statistical claim here)."""
+    rng = np.random.default_rng(3)
+    dim, capacity = 8, 64
+    index = IVFIndex(dim, capacity=capacity, nlist=4, nprobe=4,
+                     train_min_rows=32, seed=0)
+    n_total = 3 * capacity
+    rows = clustered(rng, n_total, dim)
+    for i in range(n_total):
+        index.add([f"k{i}"], rows[i:i + 1])
+
+    s = index.stats()
+    assert s["entries"] == capacity == len(index)
+    assert s["evictions"] == s["inserts"] - capacity
+    assert s["trained_lists"] == 4
+
+    # reconstruct the surviving corpus and compare against brute
+    with index._lock:
+        survivors = list(index._order)
+        corpus = index._buf[[index._order[key] for key in survivors]].copy()
+    for q in rng.normal(size=(10, dim)).astype(np.float32):
+        got = [key for key, _ in index.query(q[None], k=5)[0]]
+        assert got == brute_ids(corpus, survivors, q, 5)
+
+    # the very last inserted row is always present: self-query is top-1
+    last = f"k{n_total - 1}"
+    top_key, top_score = index.query(rows[-1:], k=1)[0][0]
+    assert top_key == last and top_score == pytest.approx(1.0, abs=1e-5)
+
+
+def test_queries_never_touch_recency():
+    index = IVFIndex(4, capacity=4, nlist=1, train_min_rows=1000)
+    eye = np.eye(4, dtype=np.float32)
+    index.add(["a", "b", "c", "d"], eye)
+    for _ in range(5):  # hammering "a" must NOT refresh it
+        index.query(eye[:1], k=1)
+    index.add(["e"], eye[:1])  # evicts "a", the oldest INSERT
+    held = {key for key, _ in index.query(eye[:1], k=4)[0]}
+    assert held == {"b", "c", "d", "e"}
+
+
+def test_update_is_idempotent_and_moves_lists():
+    """Re-adding a key overwrites its row; the ROW decides the list, so an
+    update may migrate the key across inverted lists."""
+    rng = np.random.default_rng(4)
+    dim = 8
+    a_dir, b_dir = unit(np.eye(dim, dtype=np.float32)[:2])
+    rows = np.concatenate([
+        unit(a_dir + 0.1 * rng.normal(size=(40, dim)).astype(np.float32)),
+        unit(b_dir + 0.1 * rng.normal(size=(40, dim)).astype(np.float32)),
+    ])
+    keys = [f"k{i}" for i in range(80)]
+    index = IVFIndex(dim, capacity=128, nlist=2, nprobe=1, train_min_rows=64)
+    index.add(keys, rows)
+    assert index.stats()["trained_lists"] == 2
+
+    index.add(["probe"], a_dir[None])
+    entries = index.stats()["entries"]
+    assert [k for k, _ in index.query(a_dir[None], k=1)[0]] == ["probe"]
+    index.add(["probe"], b_dir[None])  # same key, opposite cluster
+    s = index.stats()
+    assert s["entries"] == entries and s["updates"] == 1
+    # with nprobe=1 only the nearest list is scanned: the key answers from
+    # its NEW direction and is gone from the old one
+    assert [k for k, _ in index.query(b_dir[None], k=1)[0]] == ["probe"]
+    assert "probe" not in {
+        k for k, _ in index.query(a_dir[None], k=50)[0]
+    }
+
+
+# ------------------------------------------------------- clear / threads
+
+
+def test_clear_drops_rows_and_centroids():
+    rng = np.random.default_rng(5)
+    index = IVFIndex(8, capacity=256, nlist=4, train_min_rows=32)
+    index.add([f"k{i}" for i in range(64)], clustered(rng, 64, 8))
+    assert index.stats()["trained_lists"] == 4
+    index.clear()
+    s = index.stats()
+    assert len(index) == 0 and s["trained_lists"] == 0
+    assert index.query(np.ones((1, 8), np.float32), k=3) == [[]]
+    # the index is fully reusable after the promote seam
+    index.add([f"n{i}" for i in range(64)], clustered(rng, 64, 8))
+    assert len(index) == 64 and index.stats()["trained_lists"] == 4
+
+
+def test_clear_under_concurrent_queries():
+    """The promote seam races live /neighbors traffic: queries before the
+    clear see the old corpus, queries after see empty-or-new, and nothing
+    raises or returns a torn view (keys from both spaces in one answer)."""
+    rng = np.random.default_rng(6)
+    index = IVFIndex(8, capacity=256, nlist=4, train_min_rows=32)
+    index.add([f"old{i}" for i in range(64)], clustered(rng, 64, 8))
+    q = rng.normal(size=(1, 8)).astype(np.float32)
+    stop = threading.Event()
+    errors, torn = [], []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                for hits in index.query(q, k=8):
+                    spaces = {key[:3] for key, _ in hits}
+                    if len(spaces) > 1:
+                        torn.append(spaces)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    index.clear()
+    index.add([f"new{i}" for i in range(64)], clustered(rng, 64, 8))
+    stop.set()
+    for t in threads:
+        t.join(10)
+    assert not errors and not torn
+
+
+def test_concurrent_add_query_retrain_threads():
+    """Writers push enough rows to cross several retrain triggers while
+    readers hammer queries: no exceptions, budget respected, counters
+    coherent."""
+    rng = np.random.default_rng(7)
+    index = IVFIndex(8, capacity=128, nlist=4, nprobe=2,
+                     train_min_rows=32, retrain_drift=0.25)
+    stop = threading.Event()
+    errors = []
+
+    def writer(tag):
+        try:
+            for i in range(400):
+                row = clustered(rng, 1, 8)
+                index.add([f"{tag}{i}"], row)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader():
+        q = np.ones((1, 8), np.float32)
+        try:
+            while not stop.is_set():
+                for hits in index.query(q, k=5):
+                    for _, score in hits:
+                        assert -1.001 <= score <= 1.001
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    writers = [threading.Thread(target=writer, args=(t,)) for t in "ab"]
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for t in readers + writers:
+        t.start()
+    for t in writers:
+        t.join(60)
+    stop.set()
+    for t in readers:
+        t.join(10)
+    assert not errors
+    s = index.stats()
+    assert s["entries"] == 128  # 800 inserts through a 128 budget
+    assert s["inserts"] == 800
+    assert s["evictions"] == s["inserts"] - s["entries"]
+    assert s["retrains"] >= 2  # drift ratio fired beyond the first train
+
+
+# ------------------------------------------------------------ the ladder
+
+
+def test_resolve_retrieval_impl_ladder():
+    below, above = AUTO_IVF_MIN_CAPACITY - 1, AUTO_IVF_MIN_CAPACITY
+    assert resolve_retrieval_impl("auto", below)[0] == "brute"
+    assert resolve_retrieval_impl("auto", above)[0] == "ivf"
+    # explicit choices are honored regardless of the threshold
+    assert resolve_retrieval_impl("brute", above)[0] == "brute"
+    impl, reason = resolve_retrieval_impl("ivf", 4096)
+    assert impl == "ivf" and "4096" in reason
+    # disabled index: auto/brute degrade with a reason, ivf contradicts
+    impl, reason = resolve_retrieval_impl("auto", 0)
+    assert impl == "brute" and "disabled" in reason
+    with pytest.raises(ValueError, match="index_capacity is 0"):
+        resolve_retrieval_impl("ivf", 0)
+    with pytest.raises(ValueError, match="index_capacity >= nlist"):
+        resolve_retrieval_impl("ivf", 16, nlist=64)
+    with pytest.raises(ValueError, match="brute/ivf/auto"):
+        resolve_retrieval_impl("faiss", 4096)
+
+
+def test_auto_nlist_and_ctor_validation():
+    assert auto_nlist(4096) == 64  # sqrt rule
+    assert auto_nlist(1) == 8      # floor
+    assert auto_nlist(10 ** 9) == 1024  # ceiling
+    with pytest.raises(ValueError):
+        IVFIndex(0, capacity=16)
+    with pytest.raises(ValueError):
+        IVFIndex(4, capacity=16, nlist=32)  # nlist > capacity
+    with pytest.raises(ValueError):
+        IVFIndex(4, capacity=16).add(["a"], np.ones((1, 5), np.float32))
+    with pytest.raises(ValueError):
+        IVFIndex(4, capacity=16).query(np.ones((1, 4), np.float32), k=0)

@@ -360,6 +360,85 @@ def test_serve_bench_sweep_smoke_end_to_end(tmp_path):
         if m["latency"]:
             assert m["latency"]["p50_ms"] <= m["latency"]["p99_ms"]
     assert mm["admission"]["rejected"] == 0  # quota disabled in the bench
+    # the retrieval arm: closed-loop /neighbors under mixed /embed load,
+    # once per impl rung on the SAME workload stream — the IVF arm reached
+    # the trained path (not just the provisional single-list rung) and
+    # both indexes ingested the identical corpus
+    ra = artifact["retrieval"]
+    assert set(ra["per_impl"]) == {"brute", "ivf"}
+    brute, ivf = ra["per_impl"]["brute"], ra["per_impl"]["ivf"]
+    assert brute["index"]["entries"] == ivf["index"]["entries"] > 0
+    assert brute["neighbors_queries"] == ivf["neighbors_queries"] > 0
+    for arm in (brute, ivf):
+        assert arm["index"]["queries"] == arm["neighbors_queries"]
+        assert arm["query_latency"]["p50_ms"] <= arm["query_latency"]["p99_ms"]
+    assert ivf["index"]["trained_lists"] == ra["nlist"]
+    assert ivf["index"]["retrains"] >= 1
+    # early queries land on the untrained single-list rung (1 probe each),
+    # later ones fan out to nprobe lists
+    assert (ivf["neighbors_queries"] <= ivf["index"]["probes"]
+            <= ra["nprobe"] * ivf["neighbors_queries"])
+    assert ra["query_p50_ratio_brute_over_ivf"] is not None
+
+
+# ------------------------------------------------------------ retrieval_ab
+
+
+def _retrieval_rung(rows, recall=1.0, speedup=6.0):
+    return {
+        "rows": rows, "recall_at_k": recall, "speedup_p50": speedup,
+        "insert_ms": {"brute": 1.0, "ivf": 2.0}, "runs": [],
+        "lat_ms": {"brute": {"p50": 10.0, "p99": 20.0, "n": 16},
+                   "ivf": {"p50": 2.0, "p99": 4.0, "n": 16}},
+        "ivf_stats": {"trained_lists": 8, "retrains": 1},
+    }
+
+
+def test_retrieval_ab_build_output_schema():
+    """The committed docs/evidence/retrieval_ab_r18.json schema, pinned
+    without building a 262144-row index (the window_ab pattern)."""
+    retrieval_ab = _load("retrieval_ab")
+    rungs = [_retrieval_rung(4096), _retrieval_rung(65536, 0.98, 50.0)]
+    oracle = {"ids_identical": True, "scores_bit_identical": True,
+              "queries_checked": 32, "rungs_checked": [4096, 65536]}
+    out = retrieval_ab.build_output(
+        "cpu", {"dim": 64, "k": 10, "nprobe": 8}, rungs, oracle
+    )
+    assert out["schema"] == retrieval_ab.SCHEMA == "retrieval_ab/v1"
+    assert out["metric"] == "retrieval_query_ms"
+    assert "ABBA" in out["arm_order"]
+    s = out["summary"]
+    assert s["min_recall_at_k"] == 0.98
+    assert s["max_rung_rows"] == 65536 and s["speedup_p50_max_rung"] == 50.0
+    assert s["recall_bar"] == retrieval_ab.RECALL_BAR
+    assert [r["rows"] for r in s["per_rung"]] == [4096, 65536]
+    with open(os.path.join(
+        os.path.dirname(SCRIPTS), "docs", "evidence", "retrieval_ab_r18.json"
+    )) as f:
+        committed = json.load(f)
+    assert set(out) == set(committed)
+
+
+def test_retrieval_ab_smoke_oracle_and_recall(tmp_path):
+    """The real A/B end-to-end on tiny rungs: both indexes built from the
+    same chunked insert stream, the brute arm bit-checked against the
+    frozen PR-17 scoring oracle on EVERY rung before any timing, IVF
+    recall measured against the brute answers, artifact committed."""
+    retrieval_ab = _load("retrieval_ab")
+    out_path = tmp_path / "retrieval_ab.json"
+    out = retrieval_ab.main(["--smoke", "--json", str(out_path)])
+    artifact = json.loads(out_path.read_text())
+    assert artifact == json.loads(json.dumps(out))
+    assert artifact["schema"] == "retrieval_ab/v1"
+    oracle = artifact["oracle"]
+    assert oracle["ids_identical"] and oracle["scores_bit_identical"]
+    assert oracle["rungs_checked"] == [1024, 4096]
+    assert oracle["queries_checked"] > 0
+    # clustered smoke corpora: the trained quantizer holds the recall bar
+    assert artifact["summary"]["min_recall_at_k"] >= 0.95
+    top = max(artifact["rungs"], key=lambda r: r["rows"])
+    assert top["ivf_stats"]["trained_lists"] > 1  # not the provisional rung
+    assert top["speedup_p50"] > 0
 
 
 # -------------------------------------------------------------- xplane_bw
@@ -834,6 +913,64 @@ def test_ratchet_window_gate_decision():
     # on CPU the timing claim binds: the window arm must beat the host arm
     r = ratchet.window_gate_record(art(host=100.0, win=100.0))
     assert not r["ok"] and "not faster" in r["error"]
+
+
+def test_ratchet_retrieval_gate_decision():
+    """The retrieval A/B gate rides the default list: brute bit-identity
+    to the PR-17 oracle and the per-rung recall bar bind on EVERY device;
+    the CPU-calibrated p50-speedup bar at the top rung pass-skips
+    off-CPU with the reason on record."""
+    ratchet = _load("ratchet")
+    assert "retrieval_ab" in ratchet.CONFIGS
+    assert ratchet.CONFIGS["retrieval_ab"]["kind"] == "retrieval_gate"
+
+    def art(device="cpu", recall=(1.0, 0.97), speedup=6.0, ids=True,
+            bits=True, checked=None, bar=0.95):
+        rungs = [{"rows": rows, "recall_at_k": rc}
+                 for rows, rc in zip((4096, 262144), recall)]
+        return {
+            "schema": "retrieval_ab/v1",
+            "rungs": rungs,
+            "oracle": {"ids_identical": ids, "scores_bit_identical": bits,
+                       "rungs_checked": (
+                           checked if checked is not None else [4096, 262144]
+                       )},
+            "summary": {"recall_bar": bar, "speedup_bar": 5.0,
+                        "min_recall_at_k": min(recall),
+                        "max_rung_rows": 262144,
+                        "speedup_p50_max_rung": speedup},
+            "device": device,
+        }
+
+    r = ratchet.retrieval_gate_record(art())
+    assert r["ok"] and "skipped" not in r
+    assert r["metric"] == "ratchet_retrieval_ab" and r["value"] == 6.0
+    # the oracle bind is hardware-independent: broken bit-identity fails
+    # even where the timing claim would pass-skip
+    r = ratchet.retrieval_gate_record(art(device="TPU v4", bits=False))
+    assert not r["ok"] and "bitwise" in r["error"]
+    r = ratchet.retrieval_gate_record(art(ids=False))
+    assert not r["ok"] and "ids diverge" in r["error"]
+    # ...and so is the recall bar, naming the offending rung
+    r = ratchet.retrieval_gate_record(art(device="TPU v4", recall=(1.0, 0.9)))
+    assert not r["ok"] and "262144" in r["error"]
+    # the oracle must have covered every rung in the artifact
+    r = ratchet.retrieval_gate_record(art(checked=[4096]))
+    assert not r["ok"] and "every rung" in r["error"]
+    # off-CPU: the CPU-calibrated speedup claim pass-skips
+    r = ratchet.retrieval_gate_record(art(device="TPU v4", speedup=1.0))
+    assert r["ok"] and "calibrated" in r["skipped"]
+    # on CPU the artifact's own speedup bar binds at the top rung
+    r = ratchet.retrieval_gate_record(art(speedup=4.0))
+    assert not r["ok"] and "5.0x bar" in r["error"]
+    # degenerate artifacts never pass silently
+    assert not ratchet.retrieval_gate_record({"schema": "nope"})["ok"]
+    thin = art()
+    thin["rungs"] = thin["rungs"][:1]
+    assert "two corpus-size rungs" in ratchet.retrieval_gate_record(thin)["error"]
+    bare = art(bar=None)
+    bare["summary"]["recall_bar"] = None
+    assert "no recall bar" in ratchet.retrieval_gate_record(bare)["error"]
 
 
 # ------------------------------------------------------------------ hygiene

@@ -434,6 +434,33 @@ def fleet():
     reg.close()
 
 
+@pytest.fixture()
+def fleet_ivf():
+    """The fleet fixture with the IVF rung behind the registry's
+    index_factory seam — the exact wiring build_fleet_stack does when the
+    --retrieval_impl ladder resolves to ivf."""
+    from simclr_pytorch_distributed_tpu.serve.fleet import IVFIndex
+
+    reg = ModelRegistry(
+        batcher_kwargs={"max_wait_ms": 1},
+        admission=AdmissionController(max_tenant_rows=0),
+        index_capacity=16,
+        index_factory=lambda dim: IVFIndex(
+            dim, capacity=16, nlist=2, nprobe=2, train_min_rows=1000
+        ),
+    )
+    reg.add_model("prod", FakeEngine(scale=1.0))
+    server = create_fleet_server(
+        reg, port=0, metrics_fn=fleet_metrics_fn(reg),
+    )
+    start_in_thread(server)
+    host, port = server.server_address[:2]
+    yield f"http://{host}:{port}", reg, None
+    server.shutdown()
+    server.server_close()
+    reg.close()
+
+
 def test_http_embed_routes_and_defaults(fleet):
     base, _, _ = fleet
     x = imgs(3)
@@ -472,6 +499,59 @@ def test_http_neighbors_roundtrip(fleet):
     with pytest.raises(urllib.error.HTTPError) as exc:
         post(base, "/neighbors", {"images": imgs(1).tolist(), "k": 0})
     assert exc.value.code == 400
+
+
+def test_http_neighbors_k_bounded_by_max_k(fleet):
+    """k above --neighbors_max_k is a 400, not an O(k) scan: the bound is
+    the frontend's, the index's min(k, entries) clamp stays below it."""
+    base, _, _ = fleet
+    post(base, "/embed", {"images": imgs(7).tolist()})
+    # the default bound (100) admits k=100 and rejects k=101
+    status, r = post(base, "/neighbors", {"images": imgs(7).tolist(), "k": 100})
+    assert status == 200 and len(r["neighbors"][0]) == 1  # clamps to entries
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        post(base, "/neighbors", {"images": imgs(7).tolist(), "k": 101})
+    assert exc.value.code == 400
+    assert "neighbors_max_k" in json.loads(exc.value.read())["error"]
+
+
+def test_http_neighbors_max_k_disabled():
+    """--neighbors_max_k 0 disables the bound (the opt-out the flag help
+    promises)."""
+    reg = make_registry(index_capacity=8)
+    reg.add_model("m", FakeEngine())
+    server = create_fleet_server(reg, port=0, neighbors_max_k=0)
+    start_in_thread(server)
+    host, port = server.server_address[:2]
+    try:
+        base = f"http://{host}:{port}"
+        post(base, "/embed", {"images": imgs(1).tolist()})
+        status, r = post(
+            base, "/neighbors", {"images": imgs(1).tolist(), "k": 5000}
+        )
+        assert status == 200 and len(r["neighbors"][0]) == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+        reg.close()
+
+
+def test_http_neighbors_roundtrip_on_ivf_index(fleet_ivf):
+    """The IVF rung behind the SAME HTTP surface: /embed feeds the index
+    through index_factory-built IVFIndex, /neighbors answers from it, and
+    the untrained small corpus answers exactly (self top-1 at score 1)."""
+    base, reg, _ = fleet_ivf
+    corpus = imgs(10, 20, 30)
+    post(base, "/embed", {"images": corpus.tolist()})
+    status, r = post(base, "/neighbors", {"images": imgs(20).tolist(), "k": 2})
+    assert status == 200 and r["k"] == 2
+    hits = r["neighbors"][0]
+    assert hits[0]["id"] == reg.content_id(imgs(20)[0])
+    assert hits[0]["score"] == pytest.approx(1.0, abs=1e-5)
+    # promote clears rows AND centroids through the impl-blind registry
+    reg.promote(r["model"], FakeEngine(scale=2.0))
+    stats = reg.stats()["models"][r["model"]]["index"]
+    assert stats["entries"] == 0 and stats["trained_lists"] == 0
 
 
 def test_http_promote_swaps_and_drains(fleet):
@@ -522,3 +602,45 @@ def test_http_metrics_exposition(fleet):
     # ...and the labeled per-model operator series
     assert 'serve_fleet_model_serving_version{model="prod"} 1' in text
     assert 'serve_fleet_index_entries{model="prod"} 1' in text
+    # the per-model retrieval counters (probes/retrains read 0 on the
+    # brute rung — the gauge set is impl-uniform so dashboards never
+    # branch on the ladder)
+    assert 'serve_fleet_index_inserts_total{model="prod"} 1' in text
+    assert 'serve_fleet_index_evictions_total{model="prod"} 0' in text
+    assert 'serve_fleet_index_queries_total{model="prod"} 0' in text
+    assert 'serve_fleet_index_probes_total{model="prod"} 0' in text
+    assert 'serve_fleet_index_retrains_total{model="prod"} 0' in text
+
+
+def test_fleet_cli_retrieval_ladder_flags():
+    """The --retrieval_impl ladder on the fleet CLI: defaults, and the
+    honored-or-raise contract firing at startup BEFORE any engine is
+    built when an explicit ivf ask contradicts --index_capacity 0."""
+    from simclr_pytorch_distributed_tpu.serve.fleet.frontend import (
+        DEFAULT_NEIGHBORS_MAX_K,
+        build_fleet_stack,
+        build_parser,
+    )
+
+    args = build_parser().parse_args([])
+    assert args.retrieval_impl == "auto"
+    assert args.ivf_nlist == 0  # 0 = sqrt(capacity) auto
+    assert args.ivf_nprobe == 8
+    assert args.neighbors_max_k == DEFAULT_NEIGHBORS_MAX_K == 100
+    bad = build_parser().parse_args(
+        ["--retrieval_impl", "ivf", "--index_capacity", "0"]
+    )
+    with pytest.raises(ValueError, match="index_capacity"):
+        build_fleet_stack(bad)
+
+
+def test_http_metrics_ivf_probe_and_query_counters(fleet_ivf):
+    base, _, _ = fleet_ivf
+    post(base, "/embed", {"images": imgs(1, 2).tolist()})
+    post(base, "/neighbors", {"images": imgs(1).tolist(), "k": 1})
+    _, text = get_raw(base, "/metrics")
+    assert 'serve_fleet_index_entries{model="prod"} 2' in text
+    assert 'serve_fleet_index_queries_total{model="prod"} 1' in text
+    # untrained rung: one provisional list, one probe per query
+    assert 'serve_fleet_index_probes_total{model="prod"} 1' in text
+    assert 'serve_fleet_index_retrains_total{model="prod"} 0' in text
