@@ -98,6 +98,11 @@ def fingerprint_for(stage, detail):
     conv_impl = detail.get("conv_impl", "xla")
     if conv_impl != "xla":
         ident["conv_impl"] = conv_impl
+        # the pallas arm exists in fp32 AND bf16 compute (round 19): the
+        # dtype changes the workload, so the scan must not compare across
+        # it. Scoped to non-xla impls so every committed record (all xla
+        # so far) keys exactly as before.
+        ident["conv_dtype"] = detail.get("conv_dtype", "fp32")
     blob = json.dumps(ident, sort_keys=True).encode()
     return hashlib.sha1(blob).hexdigest()[:12]
 

@@ -3,7 +3,7 @@
 ``run_lint`` is pure file-system-in, records-out (no jax, no imports of
 the analyzed code); ``build_output`` is the schema-pinned artifact shape
 the ratchet gate (scripts/ratchet.py lint_gate_record) and the committed
-evidence (docs/evidence/invariant_lint_r18.json) both bind on.
+evidence (docs/evidence/invariant_lint_r19.json) both bind on.
 """
 
 from __future__ import annotations

@@ -95,13 +95,15 @@ class SupConConfig:
     # (parallel/collectives.py) for large-global-batch memory scaling
     loss_impl: str = "auto"
     # conv-block implementation for the encoder's hot path: 'pallas' routes
-    # the stem and the identity-shortcut BasicBlocks through the fused
-    # conv+BN+ReLU residual-block kernels (ops/pallas_conv.py — the
-    # inter-op activation round-trips that fund XLA's stage-1 BN-backward/
-    # residual fusions never touch HBM); 'xla' is the bitwise-pinned
-    # default path; 'auto' picks pallas only on a single-chip TPU mesh at
-    # supported stage geometries (train.supcon.resolve_conv_impl, the
-    # --loss_impl ladder convention, startup banner names the resolution)
+    # the stem, BasicBlocks (identity AND projection/stride-2 shortcuts),
+    # and rn50-family Bottlenecks through the fused conv+BN+ReLU kernels
+    # (ops/pallas_conv.py — the inter-op activation round-trips that fund
+    # XLA's stage-1 BN-backward/residual fusions never touch HBM), in fp32
+    # or bf16 compute (fp32 MXU accumulation, fp32 BN statistics); 'xla'
+    # is the bitwise-pinned default path; 'auto' picks pallas only on a
+    # single-chip TPU mesh at supported stage geometries
+    # (train.supcon.resolve_conv_impl, the --loss_impl ladder convention,
+    # startup banner names the resolution and the compute dtype)
     conv_impl: str = "auto"
     # 'sgd' is the published recipe (util.py:79-84); 'lars' for the
     # large-global-batch configs (SimCLR ImageNet bs=4096, BASELINE configs[4])
@@ -353,11 +355,14 @@ def supcon_parser() -> argparse.ArgumentParser:
     p.add_argument("--conv_impl", type=str, default=d.conv_impl,
                    choices=["auto", "xla", "pallas"],
                    help="encoder conv-block path: fused Pallas "
-                        "conv+BN+ReLU stem/residual-block kernels "
-                        "(ops/pallas_conv.py) vs the bitwise-pinned XLA "
-                        "path; 'auto' = pallas only on a single-chip TPU "
-                        "at supported geometries (startup banner names "
-                        "the resolution)")
+                        "conv+BN+ReLU kernels (ops/pallas_conv.py) for "
+                        "the stem, BasicBlocks (identity and "
+                        "projection/stride-2 shortcuts), and rn50-family "
+                        "Bottlenecks, fp32 or bf16 compute, vs the "
+                        "bitwise-pinned XLA path; 'auto' = pallas only "
+                        "on a single-chip TPU at supported geometries "
+                        "(startup banner names the resolution and "
+                        "compute dtype)")
     p.add_argument("--optimizer", type=str, default=d.optimizer,
                    choices=["sgd", "lars"],
                    help="lars: layer-adaptive scaling for large global batches")
@@ -536,23 +541,19 @@ def validate_data_placement(dataset: str, data_placement: str) -> None:
 
 
 def validate_conv_impl(cfg: SupConConfig) -> None:
-    """Parse-time check of --conv_impl interactions (the
+    """Parse-time seam for --conv_impl interactions (the
     validate_data_placement convention: reject up front what would
     otherwise silently no-op far from the flag).
 
-    The fused kernels implement fp32 whole-batch train-mode BN only, so an
-    EXPLICIT ``--conv_impl pallas`` together with ``--bf16`` would leave
-    zero admitted sites — the flag would be a silent no-op while the user
-    believes the fused path is on. ``auto`` is allowed to degrade (with
-    the startup banner naming the reason).
+    Deliberately empty since round 19: the fused kernels carry bf16
+    variants (fp32 MXU accumulation, fp32 BN statistics), so
+    ``--conv_impl pallas --bf16`` is a real configuration, admitted
+    site-by-site at RESOLUTION time (train.supcon.resolve_conv_impl —
+    explicit pallas raises there only where zero sites admit, 'auto'
+    degrades with the reason in the startup banner). The seam stays so a
+    future parse-time contradiction has a pinned home and the call site
+    in finalize_supcon keeps its ordering guarantee.
     """
-    if cfg.conv_impl == "pallas" and cfg.bf16:
-        raise ValueError(
-            "--conv_impl pallas requires fp32 compute (the fused kernels "
-            "implement fp32 whole-batch BN; docs/PERF.md round 15) — drop "
-            "--bf16, or use --conv_impl auto, which degrades to xla with "
-            "a banner"
-        )
 
 
 def impl_resolution_banner(

@@ -11,13 +11,29 @@ too thin per byte for XLA's generic conv emitter, and every inter-op
 boundary (conv -> BN stats -> normalize/ReLU -> conv -> BN -> residual add)
 funds a full HBM round trip of a ``[2B, H, W, C]`` activation array.
 
-WHAT: two fused ops that keep those boundaries in VMEM/registers —
+WHAT: four fused ops that keep those boundaries in VMEM/registers —
 
 - ``fused_conv_bn_relu``: the ResNet stem (conv3x3/s1 + train-mode BN +
   ReLU) as one kernel;
 - ``fused_basic_block``: the identity-shortcut BasicBlock
   (conv3x3 -> BN -> ReLU -> conv3x3 -> BN -> +residual -> ReLU) as one
-  kernel, forward and custom-VJP backward.
+  kernel, forward and custom-VJP backward;
+- ``fused_projection_block``: the projection-shortcut / stride-2
+  BasicBlock — the main path plus the 1x1-conv-BN shortcut and the
+  add-ReLU in the same sequential grid (the shortcut's strided 1x1 is a
+  slice of the already-resident x tile, so it adds no HBM traversals);
+- ``fused_bottleneck_block``: the rn50-class Bottleneck
+  (1x1 -> 3x3/s -> 1x1, expansion 4) with identity or fused-projection
+  shortcut; its 1x1 convs are pure ``[N*H*W, C] @ [C, C']`` contractions
+  needing no im2col scratch.
+
+Every op admits fp32 and bf16 compute (inferred from ``x.dtype`` or via
+``compute_dtype``): bf16 carries activations/weights at half the HBM
+bytes and feeds bf16 MXU matmuls, while every matmul accumulates fp32
+(``preferred_element_type``) and BN statistics / folded scale-shift rows
+/ dW accumulators / running stats stay fp32 exactly as models/norm.py
+pins — so the param/variable trees are dtype- and impl-independent and
+checkpoints keep swapping impls.
 
 HOW: the conv is an MXU matmul over VMEM-resident im2col tiles (the
 crop-as-matmul precedent, docs/PERF.md 227x): each 3x3 window offset is one
@@ -82,62 +98,203 @@ FWD_HBM_TRAVERSALS_XLA = 9    # see derivation above
 BWD_HBM_TRAVERSALS_BLOCK = 7   # 3 reads of x + 3 reads of g + 1 write of dx
 BWD_HBM_TRAVERSALS_XLA = 12   # BN-bwd stat reads x2, dx chains, residual adds
 
+# Projection-shortcut BasicBlock (conv-BN-ReLU-conv-BN + 1x1-conv-BN
+# shortcut + add-ReLU): the shortcut's 1x1 conv and BN ride the SAME
+# phase-reads of x the main path already pays (the strided view is a
+# slice of the tile in VMEM), so the Pallas traversal counts match the
+# identity block. The XLA decomposition pays three extra fusions each
+# way (shortcut conv, shortcut BN-stat, shortcut normalize folded into
+# the residual add) — derivation in docs/PERF.md round 19.
+FWD_HBM_TRAVERSALS_PROJ = 4
+FWD_HBM_TRAVERSALS_PROJ_XLA = 12
+BWD_HBM_TRAVERSALS_PROJ = 7
+BWD_HBM_TRAVERSALS_PROJ_XLA = 16
+
+# Bottleneck (1x1 -> 3x3 -> 1x1, expansion 4): four phases each re-read
+# x (+1 output write forward; four re-reads of x, four of g, +1 dx write
+# backward). Its 1x1 convs are pure [N*H*W, C] @ [C, C'] contractions
+# with no im2col scratch, so the per-phase resident set stays small
+# despite the 4x-wide output. XLA's decomposition pays one conv + one
+# BN-stat + one normalize boundary per stage plus the residual trio —
+# derivation in docs/PERF.md round 19.
+FWD_HBM_TRAVERSALS_BOTTLENECK = 5
+FWD_HBM_TRAVERSALS_BOTTLENECK_XLA = 14
+BWD_HBM_TRAVERSALS_BOTTLENECK = 9
+BWD_HBM_TRAVERSALS_BOTTLENECK_XLA = 18
+
 # VMEM budget the geometry gate admits against (bytes). Deliberately
 # conservative vs the ~16 MB/core physical VMEM: the estimate below is a
 # model of the kernel's resident set, not the compiler's exact allocation.
 VMEM_BUDGET = 10 * 1024 * 1024
 
 
-def _pick_batch_tile(n: int, h: int, w: int, cin: int, cout: int,
-                     *, residual: bool) -> Optional[int]:
-    """Largest batch-tile size (<= 8) dividing ``n`` whose estimated VMEM
-    resident set fits the budget, or None."""
+# Compute dtypes the kernels admit. Activations/weights are carried in
+# the compute dtype; BN statistics, folded scale/shift rows, matmul
+# accumulators (``preferred_element_type``) and dW accumulators stay
+# fp32 regardless, matching models/norm.py's fp32-stats pin.
+_COMPUTE_DTYPES = (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
+
+
+def _itemsize(dtype) -> Optional[int]:
+    """Bytes per element of an admitted compute dtype, else None."""
+    dt = jnp.dtype(dtype)
+    return dt.itemsize if dt in _COMPUTE_DTYPES else None
+
+
+def _pick_tile(n: int, fits) -> Optional[int]:
+    """Largest batch-tile size (<= 8) dividing ``n`` for which ``fits(bn)``
+    holds, or None."""
     for bn in (8, 4, 2, 1):
         if n % bn:
             continue
-        if _vmem_estimate(bn, h, w, cin, cout, residual=residual) <= VMEM_BUDGET:
+        if fits(bn):
             return bn
     return None
 
 
+def _pick_batch_tile(n: int, h: int, w: int, cin: int, cout: int,
+                     *, residual: bool, itemsize: int = 4) -> Optional[int]:
+    return _pick_tile(
+        n,
+        lambda bn: _vmem_estimate(
+            bn, h, w, cin, cout, residual=residual, itemsize=itemsize
+        ) <= VMEM_BUDGET,
+    )
+
+
 def _vmem_estimate(bn: int, h: int, w: int, cin: int, cout: int,
-                   *, residual: bool) -> int:
+                   *, residual: bool, itemsize: int = 4) -> int:
     """Modeled peak VMEM bytes of the WORST kernel (the backward) at this
     geometry: padded scratch tiles, weight blocks (incl. the flipped
     copies), dW accumulators, and a conservative multiplier for the
-    per-step activation values the compiler keeps live."""
-    pad = bn * (h + 2) * (w + 2) * 4
+    per-step activation values the compiler keeps live. ``itemsize`` is
+    the compute dtype's width — pads and weight blocks are carried in it;
+    accumulators and live fp32 intermediates are not."""
+    pad = bn * (h + 2) * (w + 2) * itemsize
     tile = bn * h * w * 4
     if not residual:  # stem: one conv, cin != cout
         pads = 2 * pad * max(cin, cout)  # xpad + gpad
-        weights = 2 * 9 * cin * cout * 4  # k + kt
+        weights = 2 * 9 * cin * cout * itemsize  # k + kt
         dw_acc = 9 * cin * cout * 4
         live = 6 * tile * max(cin, cout)
     else:  # basic block: two cin==cout convs
         pads = 3 * pad * cout            # xpad + apad + gpad
-        weights = 4 * 9 * cout * cout * 4  # k1, k2, k1t, k2t
+        weights = 4 * 9 * cout * cout * itemsize  # k1, k2, k1t, k2t
         dw_acc = 2 * 9 * cout * cout * 4
         live = 8 * tile * cout
     return pads + weights + dw_acc + live
 
 
+def _vmem_estimate_proj(bn: int, hi: int, wi: int, cin: int, c: int,
+                        stride: int, itemsize: int = 4) -> int:
+    """Modeled backward resident set of the projection-shortcut block:
+    one input-resolution x pad, two output-resolution pads (a1 / dy2),
+    one input-resolution pad for the dilated dy1 (stride-2 dx), the
+    weight blocks incl. flipped copies and the 1x1 shortcut, fp32 dW
+    accumulators, and the live fp32 intermediates at the wider of the
+    two resolutions."""
+    ho, wo = hi // stride, wi // stride
+    pad_in = bn * (hi + 2) * (wi + 2) * itemsize
+    pad_out = bn * (ho + 2) * (wo + 2) * itemsize
+    pads = pad_in * cin + 2 * pad_out * c + pad_in * c
+    weights = (2 * 9 * cin * c + 2 * 9 * c * c + cin * c) * itemsize
+    dw_acc = (9 * cin * c + 9 * c * c + cin * c) * 4
+    live = 8 * bn * max(hi * wi * cin, ho * wo * c) * 4
+    return pads + weights + dw_acc + live
+
+
+def _vmem_estimate_bottleneck(bn: int, hi: int, wi: int, cin: int,
+                              planes: int, stride: int, proj: bool,
+                              itemsize: int = 4) -> int:
+    """Modeled backward resident set of the Bottleneck: its 1x1 convs are
+    pure [N*H*W, C] @ [C, C'] contractions needing NO im2col pad scratch,
+    so only the middle 3x3 pays two input-resolution pads (a1 / dilated
+    dy2); weights incl. the flipped 3x3 copy and the optional 1x1
+    shortcut, fp32 dW accumulators, and live fp32 intermediates at the
+    wider of input resolution (cin/planes channels) and output resolution
+    (4*planes channels)."""
+    ho, wo = hi // stride, wi // stride
+    pad_in = bn * (hi + 2) * (wi + 2) * itemsize
+    pads = 2 * pad_in * planes
+    weights = (cin * planes + 2 * 9 * planes * planes
+               + planes * 4 * planes
+               + (cin * 4 * planes if proj else 0)) * itemsize
+    dw_acc = (cin * planes + 9 * planes * planes + planes * 4 * planes
+              + (cin * 4 * planes if proj else 0)) * 4
+    live = 8 * bn * max(hi * wi * max(cin, planes), ho * wo * 4 * planes) * 4
+    return pads + weights + dw_acc + live
+
+
 def supports_block(n: int, h: int, w: int, c: int, *, stride: int = 1,
-                   in_channels: Optional[int] = None) -> bool:
-    """True if the fused BasicBlock kernel admits this geometry: identity
-    shortcut (stride 1, in==out channels), spatial dims that the padded
-    3x3 window covers, and a batch tile whose resident set fits VMEM."""
-    if stride != 1 or (in_channels is not None and in_channels != c):
+                   in_channels: Optional[int] = None,
+                   dtype=jnp.float32) -> bool:
+    """True if a fused BasicBlock kernel admits this geometry.
+
+    ``h``/``w`` are the block's INPUT spatial dims (the pre-stride shape —
+    the convention `models.resnet.fused_site_plan` single-sources).
+    Identity-shortcut sites (stride 1, in==out channels) use the identity
+    kernel; stride-2 and/or channel-changing sites use the
+    projection-shortcut kernel, which additionally requires even spatial
+    dims for stride 2 (the kernel's dilated transposed-conv backward
+    assumes ho == h // 2 exactly)."""
+    itemsize = _itemsize(dtype)
+    if itemsize is None:
         return False
-    if h < 3 or w < 3 or n < 1 or c < 1:
+    cin = c if in_channels is None else in_channels
+    if stride not in (1, 2):
         return False
-    return _pick_batch_tile(n, h, w, c, c, residual=True) is not None
+    if h < 3 or w < 3 or n < 1 or c < 1 or cin < 1:
+        return False
+    if stride == 1 and cin == c:
+        return _pick_batch_tile(
+            n, h, w, c, c, residual=True, itemsize=itemsize
+        ) is not None
+    if stride == 2 and (h % 2 or w % 2):
+        return False
+    return _pick_tile(
+        n,
+        lambda bn: _vmem_estimate_proj(
+            bn, h, w, cin, c, stride, itemsize
+        ) <= VMEM_BUDGET,
+    ) is not None
 
 
-def supports_stem(n: int, h: int, w: int, cin: int, cout: int) -> bool:
+def supports_stem(n: int, h: int, w: int, cin: int, cout: int,
+                  *, dtype=jnp.float32) -> bool:
     """True if the fused stem kernel admits this geometry (conv3x3/s1)."""
+    itemsize = _itemsize(dtype)
+    if itemsize is None:
+        return False
     if h < 3 or w < 3 or n < 1 or cin < 1 or cout < 1:
         return False
-    return _pick_batch_tile(n, h, w, cin, cout, residual=False) is not None
+    return _pick_batch_tile(
+        n, h, w, cin, cout, residual=False, itemsize=itemsize
+    ) is not None
+
+
+def supports_bottleneck(n: int, h: int, w: int, planes: int, *,
+                        stride: int = 1, in_channels: int,
+                        dtype=jnp.float32) -> bool:
+    """True if the fused Bottleneck kernel (1x1 -> 3x3/s -> 1x1,
+    expansion 4) admits this geometry. ``h``/``w`` are the block's INPUT
+    spatial dims; identity sites (stride 1, in == 4*planes) skip the
+    shortcut conv, all others use the fused 1x1-conv-BN projection."""
+    itemsize = _itemsize(dtype)
+    if itemsize is None:
+        return False
+    if stride not in (1, 2):
+        return False
+    if h < 3 or w < 3 or n < 1 or planes < 1 or in_channels < 1:
+        return False
+    if stride == 2 and (h % 2 or w % 2):
+        return False
+    proj = stride != 1 or in_channels != 4 * planes
+    return _pick_tile(
+        n,
+        lambda bn: _vmem_estimate_bottleneck(
+            bn, h, w, in_channels, planes, stride, proj, itemsize
+        ) <= VMEM_BUDGET,
+    ) is not None
 
 
 def _vmem_spec(block_shape=None, index_map=None):
@@ -147,44 +304,81 @@ def _vmem_spec(block_shape=None, index_map=None):
 
 
 def _fill_pad(pad_ref, x):
-    """Zero-pad ``x`` by 1 pixel on each spatial edge into VMEM scratch."""
-    pad_ref[:] = jnp.zeros(pad_ref.shape, jnp.float32)
-    pad_ref[:, 1:-1, 1:-1, :] = x
+    """Zero-pad ``x`` by 1 pixel on each spatial edge into VMEM scratch,
+    cast to the scratch's (compute) dtype."""
+    pad_ref[:] = jnp.zeros(pad_ref.shape, pad_ref.dtype)
+    pad_ref[:, 1:-1, 1:-1, :] = x.astype(pad_ref.dtype)
 
 
-def _conv3x3(pad_ref, w, h: int, wdt: int):
-    """3x3/s1 conv as 9 shifted MXU matmuls over the padded VMEM tile.
+def _win(pv, di: int, dj: int, ho: int, wo: int, stride: int):
+    """The (di, dj) 3x3-window view of a padded tile VALUE at the given
+    stride: output position o reads padded input index ``stride*o + d``."""
+    if stride == 1:
+        return pv[:, di:di + ho, dj:dj + wo, :]
+    return pv[:, di:di + stride * ho:stride, dj:dj + stride * wo:stride, :]
 
-    ``pad_ref``: scratch ref ``[bn, h+2, w+2, cin]`` (already filled);
-    ``w``: kernel VALUE ``[3, 3, cin, cout]``. Each window offset is one
-    ``[bn*h*w, cin] @ [cin, cout]`` contraction — the im2col matrix is
-    never materialized, only its shifted views are read back out of the
-    same padded tile.
+
+def _conv3x3(pad_ref, w, ho: int, wo: int, stride: int = 1):
+    """3x3 conv (pad 1, stride ``stride``) as 9 shifted MXU matmuls over
+    the padded VMEM tile.
+
+    ``pad_ref``: scratch ref ``[bn, hi+2, wi+2, cin]`` (already filled);
+    ``w``: kernel VALUE ``[3, 3, cin, cout]``; ``ho``/``wo`` the OUTPUT
+    spatial dims (``hi // stride``). Each window offset is one
+    ``[bn*ho*wo, cin] @ [cin, cout]`` contraction with fp32 accumulation
+    (``preferred_element_type``) — the im2col matrix is never
+    materialized, only its (strided) shifted views are read back out of
+    the same padded tile.
     """
     bn, _, _, cin = pad_ref.shape
     cout = w.shape[3]
+    pv = pad_ref[:]
     acc = None
     for di in range(3):
         for dj in range(3):
-            xs = pad_ref[:, di:di + h, dj:dj + wdt, :].reshape(bn * h * wdt, cin)
+            xs = _win(pv, di, dj, ho, wo, stride).reshape(bn * ho * wo, cin)
             t = jnp.dot(xs, w[di, dj], preferred_element_type=jnp.float32)
             acc = t if acc is None else acc + t
-    return acc.reshape(bn, h, wdt, cout)
+    return acc.reshape(bn, ho, wo, cout)
 
 
-def _dw_accumulate(dw_ref, pad_ref, dy, h: int, wdt: int):
+def _dw_accumulate(dw_ref, pad_ref, dy, ho: int, wo: int, stride: int = 1):
     """dW[di,dj] += x_window(di,dj)^T @ dy for all 9 offsets, into the
-    ``[9*cin, cout]`` scratch accumulator."""
+    ``[9*cin, cout]`` fp32 scratch accumulator. ``dy`` is rounded to the
+    pad's compute dtype first (the XLA cast-VJP boundary)."""
     bn, _, _, cin = pad_ref.shape
     cout = dy.shape[3]
-    dyf = dy.reshape(bn * h * wdt, cout)
+    pv = pad_ref[:]
+    dyf = dy.reshape(bn * ho * wo, cout).astype(pad_ref.dtype)
     for di in range(3):
         for dj in range(3):
-            xs = pad_ref[:, di:di + h, dj:dj + wdt, :].reshape(bn * h * wdt, cin)
+            xs = _win(pv, di, dj, ho, wo, stride).reshape(bn * ho * wo, cin)
             k = di * 3 + dj
             dw_ref[k * cin:(k + 1) * cin, :] += jnp.dot(
                 xs.T, dyf, preferred_element_type=jnp.float32
             )
+
+
+def _mm(v, w2):
+    """1x1 conv as a pure ``[bn*h*w, cin] @ [cin, cout]`` MXU contraction
+    with fp32 accumulation (no im2col scratch needed)."""
+    bn, h, w, cin = v.shape
+    out = jnp.dot(
+        v.reshape(bn * h * w, cin), w2, preferred_element_type=jnp.float32
+    )
+    return out.reshape(bn, h, w, w2.shape[1])
+
+
+def _dilate2(v):
+    """Zero-dilate a ``[bn, ho, wo, c]`` value by 2 in both spatial dims:
+    ``out[:, 2i, 2j] = v[:, i, j]``, zeros elsewhere — the scatter of a
+    stride-2 transposed conv, built from stack+reshape (no strided
+    stores)."""
+    bn, ho, wo, c = v.shape
+    z = jnp.zeros_like(v)
+    a = jnp.stack([v, z], axis=2).reshape(bn, 2 * ho, wo, c)
+    za = jnp.zeros_like(a)
+    return jnp.stack([a, za], axis=3).reshape(bn, 2 * ho, 2 * wo, c)
 
 
 def _channel_sums(v, c: int):
@@ -231,7 +425,7 @@ def _stem_fwd_kernel(
         sc_s[:] = s
         sc_t[:] = b_ref[:] - m * s
 
-    _fill_pad(xpad, x_ref[:].astype(jnp.float32))
+    _fill_pad(xpad, x_ref[:])
     y = _conv3x3(xpad, k_ref[:], h, w)
 
     @pl.when(p == 0)
@@ -241,7 +435,9 @@ def _stem_fwd_kernel(
 
     @pl.when(p == 1)
     def _():
-        out_ref[:] = jnp.maximum(y * sc_s[:] + sc_t[:], 0.0)
+        out_ref[:] = jnp.maximum(y * sc_s[:] + sc_t[:], 0.0).astype(
+            out_ref.dtype
+        )
 
 
 def _stem_bwd_kernel(
@@ -264,7 +460,7 @@ def _stem_bwd_kernel(
     # recompute the tile's forward from the saved batch moments
     m, v, g = m_ref[:], v_ref[:], g_ref[:]
     rs = jax.lax.rsqrt(v + eps)
-    _fill_pad(xpad, x_ref[:].astype(jnp.float32))
+    _fill_pad(xpad, x_ref[:])
     y = _conv3x3(xpad, k_ref[:], h, w)
     yh = (y - m) * rs
     pre = yh * g + b_ref[:]
@@ -282,11 +478,13 @@ def _stem_bwd_kernel(
         dy = rs * g * (dp - acc_db[:] / count - yh * acc_dg[:] / count)
         _dw_accumulate(dw_acc, xpad, dy, h, w)
         _fill_pad(gpad, dy)
-        dx_ref[:] = _conv3x3(gpad, kt_ref[:], h, w)
+        dx_ref[:] = _conv3x3(gpad, kt_ref[:], h, w).astype(dx_ref.dtype)
 
     @pl.when((p == 1) & (i == nt - 1))
     def _():
-        dw_ref[:] = dw_acc[:].reshape(3, 3, cin, dw_ref.shape[3])
+        dw_ref[:] = dw_acc[:].reshape(3, 3, cin, dw_ref.shape[3]).astype(
+            dw_ref.dtype
+        )
         dg_ref[:] = acc_dg[:]
         db_ref[:] = acc_db[:]
 
@@ -311,12 +509,12 @@ def _stem_call(x, k, g, b, eps, interpret, bn):
         in_specs=[tile, full, row, row],
         out_specs=[out_tile, row, row],
         out_shape=[
-            jax.ShapeDtypeStruct((n, h, w, cout), jnp.float32),
+            jax.ShapeDtypeStruct((n, h, w, cout), x.dtype),
             jax.ShapeDtypeStruct((1, cout), jnp.float32),
             jax.ShapeDtypeStruct((1, cout), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bn, h + 2, w + 2, cin), jnp.float32),
+            pltpu.VMEM((bn, h + 2, w + 2, cin), x.dtype),
             pltpu.VMEM((1, cout), jnp.float32),
             pltpu.VMEM((1, cout), jnp.float32),
             pltpu.VMEM((1, cout), jnp.float32),
@@ -348,14 +546,14 @@ def _stem_bwd_call(x, k, g, b, m, v, gout, eps, interpret, bn):
         in_specs=[in_tile, kfull, ktfull, row, row, row, row, g_tile],
         out_specs=[dx_tile, kfull, row, row],
         out_shape=[
-            jax.ShapeDtypeStruct((n, h, w, cin), jnp.float32),
-            jax.ShapeDtypeStruct((3, 3, cin, cout), jnp.float32),
+            jax.ShapeDtypeStruct((n, h, w, cin), x.dtype),
+            jax.ShapeDtypeStruct((3, 3, cin, cout), k.dtype),
             jax.ShapeDtypeStruct((1, cout), jnp.float32),
             jax.ShapeDtypeStruct((1, cout), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bn, h + 2, w + 2, cin), jnp.float32),
-            pltpu.VMEM((bn, h + 2, w + 2, cout), jnp.float32),
+            pltpu.VMEM((bn, h + 2, w + 2, cin), x.dtype),
+            pltpu.VMEM((bn, h + 2, w + 2, cout), x.dtype),
             pltpu.VMEM((9 * cin, cout), jnp.float32),
             pltpu.VMEM((1, cout), jnp.float32),
             pltpu.VMEM((1, cout), jnp.float32),
@@ -388,9 +586,20 @@ def _stem_bwd(eps, interpret, bn, res, ct):
 _stem.defvjp(_stem_fwd, _stem_bwd)
 
 
+def _compute_dtype(x: jax.Array, compute_dtype) -> jnp.dtype:
+    """Resolve the kernel compute dtype: explicit override, else inferred
+    from the activation dtype (bf16 in, bf16 compute; anything else
+    computes fp32)."""
+    if compute_dtype is not None:
+        return jnp.dtype(compute_dtype)
+    if x.dtype == jnp.bfloat16:
+        return jnp.dtype(jnp.bfloat16)
+    return jnp.dtype(jnp.float32)
+
+
 def fused_conv_bn_relu(
     x: jax.Array, kernel: jax.Array, scale: jax.Array, bias: jax.Array,
-    *, eps: float = 1e-5, interpret: bool = False,
+    *, eps: float = 1e-5, interpret: bool = False, compute_dtype=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Fused stem: ``relu(bn_train(conv3x3_s1(x, kernel)))`` in one kernel.
 
@@ -398,17 +607,25 @@ def fused_conv_bn_relu(
     running-stat update (``models.norm.running_stats_update``). Gradients
     flow to ``x``/``kernel``/``scale``/``bias``; the returned moments are
     ancillary (zero cotangent, like Flax BN variables).
+
+    The compute dtype (activations/weights; default: follow ``x.dtype``,
+    bf16 in means bf16 MXU matmuls) never touches BN: statistics, the
+    returned moments and the scale/bias parameters are fp32 regardless,
+    so the param/variable trees stay impl- and dtype-independent.
     """
     n, h, w, cin = x.shape
     cout = kernel.shape[3]
-    bn = _pick_batch_tile(n, h, w, cin, cout, residual=False)
+    cdt = _compute_dtype(x, compute_dtype)
+    bn = _pick_batch_tile(
+        n, h, w, cin, cout, residual=False, itemsize=cdt.itemsize
+    )
     if bn is None:
         raise ValueError(
             f"fused stem does not admit geometry [{n},{h},{w},{cin}]->{cout}"
             " (supports_stem gate)"
         )
     return _stem(
-        x.astype(jnp.float32), kernel.astype(jnp.float32),
+        x.astype(cdt), kernel.astype(cdt),
         scale.astype(jnp.float32), bias.astype(jnp.float32),
         float(eps), bool(interpret), bn,
     )
@@ -480,7 +697,9 @@ def _block_fwd_kernel(
 
         @pl.when(p == 2)
         def _():
-            out_ref[:] = jnp.maximum(y2 * scB[:] + shB[:] + x, 0.0)
+            out_ref[:] = jnp.maximum(y2 * scB[:] + shB[:] + x, 0.0).astype(
+                out_ref.dtype
+            )
 
 
 def _block_bwd_kernel(
@@ -552,12 +771,14 @@ def _block_bwd_kernel(
             _dw_accumulate(dw1_acc, xpad, dy1, h, w)
             _fill_pad(gpad, dy1)
             # residual shortcut gradient + conv1 transpose
-            dx_ref[:] = dz + _conv3x3(gpad, k1t_ref[:], h, w)
+            dx_ref[:] = (dz + _conv3x3(gpad, k1t_ref[:], h, w)).astype(
+                dx_ref.dtype
+            )
 
     @pl.when((p == 2) & (i == nt - 1))
     def _():
-        dw1_ref[:] = dw1_acc[:].reshape(3, 3, c, c)
-        dw2_ref[:] = dw2_acc[:].reshape(3, 3, c, c)
+        dw1_ref[:] = dw1_acc[:].reshape(3, 3, c, c).astype(dw1_ref.dtype)
+        dw2_ref[:] = dw2_acc[:].reshape(3, 3, c, c).astype(dw2_ref.dtype)
         dg1_ref[:] = s_dpy[:]
         db1_ref[:] = s_dp[:]
         dg2_ref[:] = s_dzy[:]
@@ -583,11 +804,11 @@ def _block_call(x, k1, g1, b1, k2, g2, b2, eps, interpret, bn):
         grid=(3, nt),
         in_specs=[tile, kfull, kfull, row, row, row, row],
         out_specs=[out_tile] + row_out,
-        out_shape=[jax.ShapeDtypeStruct((n, h, w, c), jnp.float32)]
+        out_shape=[jax.ShapeDtypeStruct((n, h, w, c), x.dtype)]
         + [jax.ShapeDtypeStruct((1, c), jnp.float32)] * 4,
         scratch_shapes=[
-            pltpu.VMEM((bn, h + 2, w + 2, c), jnp.float32),
-            pltpu.VMEM((bn, h + 2, w + 2, c), jnp.float32),
+            pltpu.VMEM((bn, h + 2, w + 2, c), x.dtype),
+            pltpu.VMEM((bn, h + 2, w + 2, c), x.dtype),
         ] + [pltpu.VMEM((1, c), jnp.float32)] * 8,
         interpret=interpret,
     )(x, k1, k2, g1[None, :], b1[None, :], g2[None, :], b2[None, :])
@@ -615,14 +836,14 @@ def _block_bwd_call(
                   row, row, row, row, row, row, row, row, tile],
         out_specs=[dx_tile, kfull, kfull, row, row, row, row],
         out_shape=[
-            jax.ShapeDtypeStruct((n, h, w, c), jnp.float32),
-            jax.ShapeDtypeStruct((3, 3, c, c), jnp.float32),
-            jax.ShapeDtypeStruct((3, 3, c, c), jnp.float32),
+            jax.ShapeDtypeStruct((n, h, w, c), x.dtype),
+            jax.ShapeDtypeStruct((3, 3, c, c), k1.dtype),
+            jax.ShapeDtypeStruct((3, 3, c, c), k2.dtype),
         ] + [jax.ShapeDtypeStruct((1, c), jnp.float32)] * 4,
         scratch_shapes=[
-            pltpu.VMEM((bn, h + 2, w + 2, c), jnp.float32),
-            pltpu.VMEM((bn, h + 2, w + 2, c), jnp.float32),
-            pltpu.VMEM((bn, h + 2, w + 2, c), jnp.float32),
+            pltpu.VMEM((bn, h + 2, w + 2, c), x.dtype),
+            pltpu.VMEM((bn, h + 2, w + 2, c), x.dtype),
+            pltpu.VMEM((bn, h + 2, w + 2, c), x.dtype),
             pltpu.VMEM((9 * c, c), jnp.float32),
             pltpu.VMEM((9 * c, c), jnp.float32),
         ] + [pltpu.VMEM((1, c), jnp.float32)] * 4,
@@ -664,7 +885,7 @@ def fused_basic_block(
     x: jax.Array,
     kernel1: jax.Array, scale1: jax.Array, bias1: jax.Array,
     kernel2: jax.Array, scale2: jax.Array, bias2: jax.Array,
-    *, eps: float = 1e-5, interpret: bool = False,
+    *, eps: float = 1e-5, interpret: bool = False, compute_dtype=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Fused identity-shortcut BasicBlock, train mode, one kernel each way.
 
@@ -674,17 +895,940 @@ def fused_basic_block(
     the running-stat updates. Differentiable w.r.t. every array argument
     (custom VJP; the backward kernel recomputes the forward per phase and
     stores no activation residual — only the O(C) batch moments).
+
+    Compute dtype follows ``x.dtype`` (bf16 in, bf16 MXU matmuls with
+    fp32 accumulation) unless overridden; BN statistics, returned
+    moments, and scale/bias stay fp32 regardless.
     """
     n, h, w, c = x.shape
-    if not supports_block(n, h, w, c):
+    cdt = _compute_dtype(x, compute_dtype)
+    if not supports_block(n, h, w, c, dtype=cdt):
         raise ValueError(
             f"fused basic block does not admit geometry [{n},{h},{w},{c}] "
             "(supports_block gate)"
         )
-    bn = _pick_batch_tile(n, h, w, c, c, residual=True)
+    bn = _pick_batch_tile(n, h, w, c, c, residual=True, itemsize=cdt.itemsize)
     f32 = jnp.float32
     return _block(
-        x.astype(f32), kernel1.astype(f32), scale1.astype(f32),
-        bias1.astype(f32), kernel2.astype(f32), scale2.astype(f32),
+        x.astype(cdt), kernel1.astype(cdt), scale1.astype(f32),
+        bias1.astype(f32), kernel2.astype(cdt), scale2.astype(f32),
         bias2.astype(f32), float(eps), bool(interpret), bn,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused projection-shortcut BasicBlock: conv3x3/s-BN-ReLU-conv3x3-BN plus
+# a 1x1-conv/s-BN shortcut and the add-ReLU, one kernel each way. Admits
+# stride 1 (channel change only) and stride 2 (even input dims — the
+# backward's dilated transposed conv assumes ho == hi // 2 exactly). The
+# shortcut gets its own accumulator set inside the SAME sequential-grid
+# phases: its 1x1 conv is a strided slice of the x tile already resident
+# for the main path, so the shortcut adds no HBM traversals.
+# ---------------------------------------------------------------------------
+
+
+def _proj_fwd_kernel(
+    x_ref, k1_ref, k2_ref, ks_ref,
+    g1_ref, b1_ref, g2_ref, b2_ref, gs_ref, bs_ref,
+    out_ref, m1_ref, v1_ref, m2_ref, v2_ref, ms_ref, vs_ref,
+    xpad, apad,
+    acc1s, acc1q, acc2s, acc2q, accSs, accSq,
+    sc1, sh1, sc2, sh2, scS, shS,
+    *, ho: int, wo: int, stride: int, count: float, eps: float,
+):
+    p = pl.program_id(0)
+    i = pl.program_id(1)
+    c = out_ref.shape[3]
+
+    @pl.when((p == 0) & (i == 0))
+    def _():
+        for acc in (acc1s, acc1q, acc2s, acc2q, accSs, accSq):
+            acc[:] = jnp.zeros_like(acc)
+
+    # stage-1 + shortcut finalize (both consumed first in phase 1 / 2)
+    @pl.when((p == 1) & (i == 0))
+    def _():
+        m = acc1s[:] / count
+        v = acc1q[:] / count - m * m
+        m1_ref[:] = m
+        v1_ref[:] = v
+        s = g1_ref[:] * jax.lax.rsqrt(v + eps)
+        sc1[:] = s
+        sh1[:] = b1_ref[:] - m * s
+        mS = accSs[:] / count
+        vS = accSq[:] / count - mS * mS
+        ms_ref[:] = mS
+        vs_ref[:] = vS
+        sS = gs_ref[:] * jax.lax.rsqrt(vS + eps)
+        scS[:] = sS
+        shS[:] = bs_ref[:] - mS * sS
+
+    @pl.when((p == 2) & (i == 0))
+    def _():
+        m = acc2s[:] / count
+        v = acc2q[:] / count - m * m
+        m2_ref[:] = m
+        v2_ref[:] = v
+        s = g2_ref[:] * jax.lax.rsqrt(v + eps)
+        sc2[:] = s
+        sh2[:] = b2_ref[:] - m * s
+
+    xv = x_ref[:]
+    _fill_pad(xpad, xv)
+    y1 = _conv3x3(xpad, k1_ref[:], ho, wo, stride)
+    xs = xv[:, ::stride, ::stride, :] if stride != 1 else xv
+    yS = _mm(xs, ks_ref[:])
+
+    @pl.when(p == 0)
+    def _():
+        acc1s[:] += _channel_sums(y1, c)
+        acc1q[:] += _channel_sums(jnp.square(y1), c)
+        accSs[:] += _channel_sums(yS, c)
+        accSq[:] += _channel_sums(jnp.square(yS), c)
+
+    @pl.when(p >= 1)
+    def _():
+        a1 = jnp.maximum(y1 * sc1[:] + sh1[:], 0.0)
+        _fill_pad(apad, a1)
+        y2 = _conv3x3(apad, k2_ref[:], ho, wo)
+
+        @pl.when(p == 1)
+        def _():
+            acc2s[:] += _channel_sums(y2, c)
+            acc2q[:] += _channel_sums(jnp.square(y2), c)
+
+        @pl.when(p == 2)
+        def _():
+            z = y2 * sc2[:] + sh2[:] + yS * scS[:] + shS[:]
+            out_ref[:] = jnp.maximum(z, 0.0).astype(out_ref.dtype)
+
+
+def _proj_bwd_kernel(
+    x_ref, k1_ref, k2_ref, ks_ref, k1t_ref, k2t_ref,
+    g1_ref, b1_ref, g2_ref, b2_ref, gs_ref, bs_ref,
+    m1_ref, v1_ref, m2_ref, v2_ref, ms_ref, vs_ref, gout_ref,
+    dx_ref, dw1_ref, dw2_ref, dws_ref,
+    dg1_ref, db1_ref, dg2_ref, db2_ref, dgs_ref, dbs_ref,
+    xpad, apad, gpadA, gpadB, dw1_acc, dw2_acc, dws_acc,
+    s_dz, s_dzy2, s_dzyS, s_dp, s_dpy,
+    *, hi: int, wi: int, ho: int, wo: int, stride: int,
+    count: float, eps: float,
+):
+    p = pl.program_id(0)
+    i = pl.program_id(1)
+    nt = pl.num_programs(1)
+    cin = x_ref.shape[3]
+    c = gout_ref.shape[3]
+
+    @pl.when((p == 0) & (i == 0))
+    def _():
+        for acc in (s_dz, s_dzy2, s_dzyS, s_dp, s_dpy,
+                    dw1_acc, dw2_acc, dws_acc):
+            acc[:] = jnp.zeros_like(acc)
+
+    # recompute the tile's whole forward from the saved batch moments
+    g1, g2, gS = g1_ref[:], g2_ref[:], gs_ref[:]
+    rs1 = jax.lax.rsqrt(v1_ref[:] + eps)
+    rs2 = jax.lax.rsqrt(v2_ref[:] + eps)
+    rsS = jax.lax.rsqrt(vs_ref[:] + eps)
+    xv = x_ref[:]
+    _fill_pad(xpad, xv)
+    y1 = _conv3x3(xpad, k1_ref[:], ho, wo, stride)
+    xs = xv[:, ::stride, ::stride, :] if stride != 1 else xv
+    yS = _mm(xs, ks_ref[:])
+    yh1 = (y1 - m1_ref[:]) * rs1
+    p1 = yh1 * g1 + b1_ref[:]
+    a1 = jnp.maximum(p1, 0.0)
+    _fill_pad(apad, a1)
+    y2 = _conv3x3(apad, k2_ref[:], ho, wo)
+    yh2 = (y2 - m2_ref[:]) * rs2
+    yhS = (yS - ms_ref[:]) * rsS
+    z = yh2 * g2 + b2_ref[:] + yhS * gS + bs_ref[:]
+    dz = gout_ref[:].astype(jnp.float32) * (z > 0.0)
+
+    @pl.when(p == 0)
+    def _():
+        s_dz[:] += _channel_sums(dz, c)
+        s_dzy2[:] += _channel_sums(dz * yh2, c)
+        s_dzyS[:] += _channel_sums(dz * yhS, c)
+
+    @pl.when(p >= 1)
+    def _():
+        # BN2 + shortcut-BN backward share the post-add dz
+        dy2 = rs2 * g2 * (dz - s_dz[:] / count - yh2 * s_dzy2[:] / count)
+        dyS = rsS * gS * (dz - s_dz[:] / count - yhS * s_dzyS[:] / count)
+
+        @pl.when(p == 1)
+        def _():
+            _dw_accumulate(dw2_acc, apad, dy2, ho, wo)
+            dws_acc[:] += jnp.dot(
+                xs.reshape(-1, cin).T,
+                dyS.reshape(-1, c).astype(xv.dtype),
+                preferred_element_type=jnp.float32,
+            )
+
+        _fill_pad(gpadA, dy2)
+        da1 = _conv3x3(gpadA, k2t_ref[:], ho, wo)
+        dp1 = da1 * (p1 > 0.0)
+
+        @pl.when(p == 1)
+        def _():
+            s_dp[:] += _channel_sums(dp1, c)
+            s_dpy[:] += _channel_sums(dp1 * yh1, c)
+
+        @pl.when(p == 2)
+        def _():
+            dy1 = rs1 * g1 * (dp1 - s_dp[:] / count - yh1 * s_dpy[:] / count)
+            _dw_accumulate(dw1_acc, xpad, dy1, ho, wo, stride)
+            # dx: transposed conv1 (dilated for stride 2) + the shortcut's
+            # 1x1 transpose scattered back to input resolution
+            vS = _mm(dyS.astype(xv.dtype), ks_ref[:].T)
+            if stride == 1:
+                gfill, dxs = dy1, vS
+            else:
+                gfill, dxs = _dilate2(dy1), _dilate2(vS)
+            _fill_pad(gpadB, gfill)
+            dx_ref[:] = (_conv3x3(gpadB, k1t_ref[:], hi, wi) + dxs).astype(
+                dx_ref.dtype
+            )
+
+    @pl.when((p == 2) & (i == nt - 1))
+    def _():
+        dw1_ref[:] = dw1_acc[:].reshape(3, 3, cin, c).astype(dw1_ref.dtype)
+        dw2_ref[:] = dw2_acc[:].reshape(3, 3, c, c).astype(dw2_ref.dtype)
+        dws_ref[:] = dws_acc[:].astype(dws_ref.dtype)
+        dg1_ref[:] = s_dpy[:]
+        db1_ref[:] = s_dp[:]
+        dg2_ref[:] = s_dzy2[:]
+        db2_ref[:] = s_dz[:]
+        dgs_ref[:] = s_dzyS[:]
+        dbs_ref[:] = s_dz[:]  # both BN biases add directly into z
+
+
+def _proj_call(x, k1, g1, b1, k2, g2, b2, ks, gs, bs,
+               eps, interpret, bn, stride):
+    n, hi, wi, cin = x.shape
+    c = k1.shape[3]
+    ho, wo = hi // stride, wi // stride
+    nt = n // bn
+    count = float(n * ho * wo)
+    kernel = functools.partial(
+        _proj_fwd_kernel, ho=ho, wo=wo, stride=stride, count=count, eps=eps
+    )
+    x_tile = _vmem_spec((bn, hi, wi, cin), lambda p, i: (i, 0, 0, 0))
+    out_tile = _vmem_spec(
+        (bn, ho, wo, c), lambda p, i: ((p == 2) * i, 0, 0, 0)
+    )
+    k1full = _vmem_spec((3, 3, cin, c), lambda p, i: (0, 0, 0, 0))
+    k2full = _vmem_spec((3, 3, c, c), lambda p, i: (0, 0, 0, 0))
+    ksfull = _vmem_spec((cin, c), lambda p, i: (0, 0))
+    row = _vmem_spec((1, c), lambda p, i: (0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(3, nt),
+        in_specs=[x_tile, k1full, k2full, ksfull] + [row] * 6,
+        out_specs=[out_tile] + [row] * 6,
+        out_shape=[jax.ShapeDtypeStruct((n, ho, wo, c), x.dtype)]
+        + [jax.ShapeDtypeStruct((1, c), jnp.float32)] * 6,
+        scratch_shapes=[
+            pltpu.VMEM((bn, hi + 2, wi + 2, cin), x.dtype),
+            pltpu.VMEM((bn, ho + 2, wo + 2, c), x.dtype),
+        ] + [pltpu.VMEM((1, c), jnp.float32) for _ in range(12)],
+        interpret=interpret,
+    )(
+        x, k1, k2, ks, g1[None, :], b1[None, :], g2[None, :], b2[None, :],
+        gs[None, :], bs[None, :],
+    )
+
+
+def _proj_bwd_call(x, k1, g1, b1, k2, g2, b2, ks, gs, bs,
+                   m1, v1, m2, v2, mS, vS, gout, eps, interpret, bn, stride):
+    n, hi, wi, cin = x.shape
+    c = k1.shape[3]
+    ho, wo = hi // stride, wi // stride
+    nt = n // bn
+    count = float(n * ho * wo)
+    kernel = functools.partial(
+        _proj_bwd_kernel, hi=hi, wi=wi, ho=ho, wo=wo, stride=stride,
+        count=count, eps=eps,
+    )
+    x_tile = _vmem_spec((bn, hi, wi, cin), lambda p, i: (i, 0, 0, 0))
+    g_tile = _vmem_spec((bn, ho, wo, c), lambda p, i: (i, 0, 0, 0))
+    dx_tile = _vmem_spec(
+        (bn, hi, wi, cin), lambda p, i: ((p == 2) * i, 0, 0, 0)
+    )
+    k1full = _vmem_spec((3, 3, cin, c), lambda p, i: (0, 0, 0, 0))
+    k2full = _vmem_spec((3, 3, c, c), lambda p, i: (0, 0, 0, 0))
+    k1tfull = _vmem_spec((3, 3, c, cin), lambda p, i: (0, 0, 0, 0))
+    ksfull = _vmem_spec((cin, c), lambda p, i: (0, 0))
+    row = _vmem_spec((1, c), lambda p, i: (0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(3, nt),
+        in_specs=[x_tile, k1full, k2full, ksfull, k1tfull, k2full]
+        + [row] * 12 + [g_tile],
+        out_specs=[dx_tile, k1full, k2full, ksfull] + [row] * 6,
+        out_shape=[
+            jax.ShapeDtypeStruct((n, hi, wi, cin), x.dtype),
+            jax.ShapeDtypeStruct((3, 3, cin, c), k1.dtype),
+            jax.ShapeDtypeStruct((3, 3, c, c), k2.dtype),
+            jax.ShapeDtypeStruct((cin, c), ks.dtype),
+        ] + [jax.ShapeDtypeStruct((1, c), jnp.float32)] * 6,
+        scratch_shapes=[
+            pltpu.VMEM((bn, hi + 2, wi + 2, cin), x.dtype),
+            pltpu.VMEM((bn, ho + 2, wo + 2, c), x.dtype),
+            pltpu.VMEM((bn, ho + 2, wo + 2, c), x.dtype),
+            pltpu.VMEM((bn, hi + 2, wi + 2, c), x.dtype),
+            pltpu.VMEM((9 * cin, c), jnp.float32),
+            pltpu.VMEM((9 * c, c), jnp.float32),
+            pltpu.VMEM((cin, c), jnp.float32),
+        ] + [pltpu.VMEM((1, c), jnp.float32) for _ in range(5)],
+        interpret=interpret,
+    )(
+        x, k1, k2, ks, _flip_transpose(k1), _flip_transpose(k2),
+        g1[None, :], b1[None, :], g2[None, :], b2[None, :],
+        gs[None, :], bs[None, :],
+        m1[None, :], v1[None, :], m2[None, :], v2[None, :],
+        mS[None, :], vS[None, :], gout,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(10, 11, 12, 13))
+def _proj(x, k1, g1, b1, k2, g2, b2, ks, gs, bs, eps, interpret, bn, stride):
+    out, _ = _proj_fwd(
+        x, k1, g1, b1, k2, g2, b2, ks, gs, bs, eps, interpret, bn, stride
+    )
+    return out
+
+
+def _proj_fwd(x, k1, g1, b1, k2, g2, b2, ks, gs, bs,
+              eps, interpret, bn, stride):
+    out, m1, v1, m2, v2, mS, vS = _proj_call(
+        x, k1, g1, b1, k2, g2, b2, ks, gs, bs, eps, interpret, bn, stride
+    )
+    res = (x, k1, g1, b1, k2, g2, b2, ks, gs, bs,
+           m1[0], v1[0], m2[0], v2[0], mS[0], vS[0])
+    return (out, m1[0], v1[0], m2[0], v2[0], mS[0], vS[0]), res
+
+
+def _proj_bwd(eps, interpret, bn, stride, res, ct):
+    (x, k1, g1, b1, k2, g2, b2, ks, gs, bs,
+     m1, v1, m2, v2, mS, vS) = res
+    gout = ct[0]  # batch-moment cotangents discarded (module docstring)
+    dx, dw1, dw2, dws, dg1, db1, dg2, db2, dgs, dbs = _proj_bwd_call(
+        x, k1, g1, b1, k2, g2, b2, ks, gs, bs,
+        m1, v1, m2, v2, mS, vS, gout, eps, interpret, bn, stride,
+    )
+    return (dx, dw1, dg1[0], db1[0], dw2, dg2[0], db2[0],
+            dws, dgs[0], dbs[0])
+
+
+_proj.defvjp(_proj_fwd, _proj_bwd)
+
+
+def fused_projection_block(
+    x: jax.Array,
+    kernel1: jax.Array, scale1: jax.Array, bias1: jax.Array,
+    kernel2: jax.Array, scale2: jax.Array, bias2: jax.Array,
+    kernel_sc: jax.Array, scale_sc: jax.Array, bias_sc: jax.Array,
+    *, stride: int = 1, eps: float = 1e-5, interpret: bool = False,
+    compute_dtype=None,
+):
+    """Fused projection-shortcut BasicBlock, train mode, one kernel each
+    way: ``relu(bn2(conv3x3(relu(bn1(conv3x3_s(x, k1))), k2))
+    + bn_sc(conv1x1_s(x, k_sc)))`` with all three BNs in whole-batch train
+    mode. ``kernel_sc`` may be ``(1, 1, cin, c)`` or ``(cin, c)``.
+
+    Returns ``(out, mean1, var1, mean2, var2, mean_sc, var_sc)`` (biased
+    variances); the caller applies the running-stat updates. Compute
+    dtype follows ``x.dtype`` unless overridden; BN state stays fp32.
+    """
+    n, h, w, cin = x.shape
+    c = kernel1.shape[3]
+    cdt = _compute_dtype(x, compute_dtype)
+    if stride == 1 and cin == c:
+        raise ValueError(
+            "projection block requires stride 2 or a channel change; "
+            "use fused_basic_block for identity-shortcut sites"
+        )
+    if not supports_block(n, h, w, c, stride=stride, in_channels=cin,
+                          dtype=cdt):
+        raise ValueError(
+            f"fused projection block does not admit geometry "
+            f"[{n},{h},{w},{cin}]->{c}/s{stride} (supports_block gate)"
+        )
+    bn = _pick_tile(
+        n,
+        lambda b: _vmem_estimate_proj(
+            b, h, w, cin, c, stride, cdt.itemsize
+        ) <= VMEM_BUDGET,
+    )
+    f32 = jnp.float32
+    return _proj(
+        x.astype(cdt), kernel1.astype(cdt), scale1.astype(f32),
+        bias1.astype(f32), kernel2.astype(cdt), scale2.astype(f32),
+        bias2.astype(f32), kernel_sc.reshape(cin, c).astype(cdt),
+        scale_sc.astype(f32), bias_sc.astype(f32),
+        float(eps), bool(interpret), bn, int(stride),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused Bottleneck (rn50-class): 1x1 -> BN-ReLU -> 3x3/s -> BN-ReLU -> 1x1
+# -> BN -> (+shortcut) -> ReLU, expansion 4, one kernel each way. The 1x1
+# convs are pure [bn*H*W, C] @ [C, C'] MXU contractions straight off the
+# resident x / a2 tiles — no im2col pad scratch; only the middle 3x3 pays
+# the padded-tile treatment. Four phases: y1+shortcut stats, y2 stats,
+# y3 stats, emit. BN1 normalizes at input resolution (count1); BN2/BN3
+# and the shortcut BN at output resolution (count2). A static ``proj``
+# flag selects the identity (stride 1, cin == 4*planes) or fused
+# 1x1-conv-BN projection shortcut variant.
+# ---------------------------------------------------------------------------
+
+
+def _bot_fwd_kernel(*refs, proj: bool, ho: int, wo: int, stride: int,
+                    count1: float, count2: float, eps: float):
+    n_in = 13 if proj else 10
+    n_out = 9 if proj else 7
+    if proj:
+        (x_ref, k1_ref, k2_ref, k3_ref, ks_ref,
+         g1_ref, b1_ref, g2_ref, b2_ref, g3_ref, b3_ref,
+         gs_ref, bs_ref) = refs[:n_in]
+        (out_ref, m1_ref, v1_ref, m2_ref, v2_ref, m3_ref, v3_ref,
+         ms_ref, vs_ref) = refs[n_in:n_in + n_out]
+        (apad, acc1s, acc1q, acc2s, acc2q, acc3s, acc3q,
+         sc1, sh1, sc2, sh2, sc3, sh3,
+         accSs, accSq, scS, shS) = refs[n_in + n_out:]
+    else:
+        (x_ref, k1_ref, k2_ref, k3_ref,
+         g1_ref, b1_ref, g2_ref, b2_ref, g3_ref, b3_ref) = refs[:n_in]
+        (out_ref, m1_ref, v1_ref, m2_ref, v2_ref,
+         m3_ref, v3_ref) = refs[n_in:n_in + n_out]
+        (apad, acc1s, acc1q, acc2s, acc2q, acc3s, acc3q,
+         sc1, sh1, sc2, sh2, sc3, sh3) = refs[n_in + n_out:]
+
+    p = pl.program_id(0)
+    i = pl.program_id(1)
+    pln = k1_ref.shape[1]
+    cout = k3_ref.shape[1]
+
+    @pl.when((p == 0) & (i == 0))
+    def _():
+        accs = [acc1s, acc1q, acc2s, acc2q, acc3s, acc3q]
+        if proj:
+            accs += [accSs, accSq]
+        for acc in accs:
+            acc[:] = jnp.zeros_like(acc)
+
+    # stage-1 (input-resolution count) + shortcut finalize
+    @pl.when((p == 1) & (i == 0))
+    def _():
+        m = acc1s[:] / count1
+        v = acc1q[:] / count1 - m * m
+        m1_ref[:] = m
+        v1_ref[:] = v
+        s = g1_ref[:] * jax.lax.rsqrt(v + eps)
+        sc1[:] = s
+        sh1[:] = b1_ref[:] - m * s
+        if proj:
+            mS = accSs[:] / count2
+            vS = accSq[:] / count2 - mS * mS
+            ms_ref[:] = mS
+            vs_ref[:] = vS
+            sS = gs_ref[:] * jax.lax.rsqrt(vS + eps)
+            scS[:] = sS
+            shS[:] = bs_ref[:] - mS * sS
+
+    @pl.when((p == 2) & (i == 0))
+    def _():
+        m = acc2s[:] / count2
+        v = acc2q[:] / count2 - m * m
+        m2_ref[:] = m
+        v2_ref[:] = v
+        s = g2_ref[:] * jax.lax.rsqrt(v + eps)
+        sc2[:] = s
+        sh2[:] = b2_ref[:] - m * s
+
+    @pl.when((p == 3) & (i == 0))
+    def _():
+        m = acc3s[:] / count2
+        v = acc3q[:] / count2 - m * m
+        m3_ref[:] = m
+        v3_ref[:] = v
+        s = g3_ref[:] * jax.lax.rsqrt(v + eps)
+        sc3[:] = s
+        sh3[:] = b3_ref[:] - m * s
+
+    xv = x_ref[:]
+    y1 = _mm(xv, k1_ref[:])
+    if proj:
+        xs = xv[:, ::stride, ::stride, :] if stride != 1 else xv
+        yS = _mm(xs, ks_ref[:])
+
+    @pl.when(p == 0)
+    def _():
+        acc1s[:] += _channel_sums(y1, pln)
+        acc1q[:] += _channel_sums(jnp.square(y1), pln)
+        if proj:
+            accSs[:] += _channel_sums(yS, cout)
+            accSq[:] += _channel_sums(jnp.square(yS), cout)
+
+    @pl.when(p >= 1)
+    def _():
+        a1 = jnp.maximum(y1 * sc1[:] + sh1[:], 0.0)
+        _fill_pad(apad, a1)
+        y2 = _conv3x3(apad, k2_ref[:], ho, wo, stride)
+
+        @pl.when(p == 1)
+        def _():
+            acc2s[:] += _channel_sums(y2, pln)
+            acc2q[:] += _channel_sums(jnp.square(y2), pln)
+
+        @pl.when(p >= 2)
+        def _():
+            a2 = jnp.maximum(y2 * sc2[:] + sh2[:], 0.0).astype(apad.dtype)
+            y3 = _mm(a2, k3_ref[:])
+
+            @pl.when(p == 2)
+            def _():
+                acc3s[:] += _channel_sums(y3, cout)
+                acc3q[:] += _channel_sums(jnp.square(y3), cout)
+
+            @pl.when(p == 3)
+            def _():
+                if proj:
+                    short = yS * scS[:] + shS[:]
+                else:
+                    short = xv.astype(jnp.float32)
+                out_ref[:] = jnp.maximum(
+                    y3 * sc3[:] + sh3[:] + short, 0.0
+                ).astype(out_ref.dtype)
+
+
+def _bot_bwd_kernel(*refs, proj: bool, hi: int, wi: int, ho: int, wo: int,
+                    stride: int, count1: float, count2: float, eps: float):
+    n_in = 23 if proj else 18
+    n_out = 13 if proj else 10
+    (x_ref, k1_ref, k2_ref, k3_ref, k2t_ref,
+     g1_ref, b1_ref, g2_ref, b2_ref, g3_ref, b3_ref,
+     m1_ref, v1_ref, m2_ref, v2_ref, m3_ref, v3_ref, gout_ref) = refs[:18]
+    if proj:
+        ks_ref, gs_ref, bs_ref, ms_ref, vs_ref = refs[18:23]
+    outs = refs[n_in:n_in + n_out]
+    (dx_ref, dw1_ref, dw2_ref, dw3_ref,
+     dg1_ref, db1_ref, dg2_ref, db2_ref, dg3_ref, db3_ref) = outs[:10]
+    if proj:
+        dws_ref, dgs_ref, dbs_ref = outs[10:]
+    scratch = refs[n_in + n_out:]
+    (apad, gpad, dw1_acc, dw2_acc, dw3_acc,
+     s_dz, s_dzy3, s_dp2, s_dp2y, s_dp1, s_dp1y) = scratch[:11]
+    if proj:
+        dws_acc, s_dzyS = scratch[11:]
+
+    p = pl.program_id(0)
+    i = pl.program_id(1)
+    nt = pl.num_programs(1)
+    cin = x_ref.shape[3]
+    pln = k1_ref.shape[1]
+    cout = k3_ref.shape[1]
+
+    @pl.when((p == 0) & (i == 0))
+    def _():
+        accs = [dw1_acc, dw2_acc, dw3_acc, s_dz, s_dzy3,
+                s_dp2, s_dp2y, s_dp1, s_dp1y]
+        if proj:
+            accs += [dws_acc, s_dzyS]
+        for acc in accs:
+            acc[:] = jnp.zeros_like(acc)
+
+    # recompute the tile's whole forward from the saved batch moments
+    g1, g2, g3 = g1_ref[:], g2_ref[:], g3_ref[:]
+    rs1 = jax.lax.rsqrt(v1_ref[:] + eps)
+    rs2 = jax.lax.rsqrt(v2_ref[:] + eps)
+    rs3 = jax.lax.rsqrt(v3_ref[:] + eps)
+    xv = x_ref[:]
+    y1 = _mm(xv, k1_ref[:])
+    yh1 = (y1 - m1_ref[:]) * rs1
+    p1 = yh1 * g1 + b1_ref[:]
+    a1 = jnp.maximum(p1, 0.0)
+    _fill_pad(apad, a1)
+    y2 = _conv3x3(apad, k2_ref[:], ho, wo, stride)
+    yh2 = (y2 - m2_ref[:]) * rs2
+    p2 = yh2 * g2 + b2_ref[:]
+    a2 = jnp.maximum(p2, 0.0).astype(xv.dtype)
+    y3 = _mm(a2, k3_ref[:])
+    yh3 = (y3 - m3_ref[:]) * rs3
+    z = yh3 * g3 + b3_ref[:]
+    if proj:
+        gS = gs_ref[:]
+        rsS = jax.lax.rsqrt(vs_ref[:] + eps)
+        xs = xv[:, ::stride, ::stride, :] if stride != 1 else xv
+        yS = _mm(xs, ks_ref[:])
+        yhS = (yS - ms_ref[:]) * rsS
+        z = z + yhS * gS + bs_ref[:]
+    else:
+        z = z + xv.astype(jnp.float32)
+    dz = gout_ref[:].astype(jnp.float32) * (z > 0.0)
+
+    @pl.when(p == 0)
+    def _():
+        s_dz[:] += _channel_sums(dz, cout)
+        s_dzy3[:] += _channel_sums(dz * yh3, cout)
+        if proj:
+            s_dzyS[:] += _channel_sums(dz * yhS, cout)
+
+    @pl.when(p >= 1)
+    def _():
+        dy3 = rs3 * g3 * (dz - s_dz[:] / count2 - yh3 * s_dzy3[:] / count2)
+        if proj:
+            dyS = rsS * gS * (
+                dz - s_dz[:] / count2 - yhS * s_dzyS[:] / count2
+            )
+
+        @pl.when(p == 1)
+        def _():
+            dw3_acc[:] += jnp.dot(
+                a2.reshape(-1, pln).T,
+                dy3.reshape(-1, cout).astype(xv.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            if proj:
+                dws_acc[:] += jnp.dot(
+                    xs.reshape(-1, cin).T,
+                    dyS.reshape(-1, cout).astype(xv.dtype),
+                    preferred_element_type=jnp.float32,
+                )
+
+        da2 = _mm(dy3.astype(xv.dtype), k3_ref[:].T)
+        dp2 = da2 * (p2 > 0.0)
+
+        @pl.when(p == 1)
+        def _():
+            s_dp2[:] += _channel_sums(dp2, pln)
+            s_dp2y[:] += _channel_sums(dp2 * yh2, pln)
+
+        @pl.when(p >= 2)
+        def _():
+            dy2 = rs2 * g2 * (
+                dp2 - s_dp2[:] / count2 - yh2 * s_dp2y[:] / count2
+            )
+
+            @pl.when(p == 2)
+            def _():
+                _dw_accumulate(dw2_acc, apad, dy2, ho, wo, stride)
+
+            gfill = _dilate2(dy2) if stride != 1 else dy2
+            _fill_pad(gpad, gfill)
+            da1 = _conv3x3(gpad, k2t_ref[:], hi, wi)
+            dp1 = da1 * (p1 > 0.0)
+
+            @pl.when(p == 2)
+            def _():
+                s_dp1[:] += _channel_sums(dp1, pln)
+                s_dp1y[:] += _channel_sums(dp1 * yh1, pln)
+
+            @pl.when(p == 3)
+            def _():
+                dy1 = rs1 * g1 * (
+                    dp1 - s_dp1[:] / count1 - yh1 * s_dp1y[:] / count1
+                )
+                dw1_acc[:] += jnp.dot(
+                    xv.reshape(-1, cin).T,
+                    dy1.reshape(-1, pln).astype(xv.dtype),
+                    preferred_element_type=jnp.float32,
+                )
+                dxm = _mm(dy1.astype(xv.dtype), k1_ref[:].T)
+                if proj:
+                    vSx = _mm(dyS.astype(xv.dtype), ks_ref[:].T)
+                    dxs = _dilate2(vSx) if stride != 1 else vSx
+                else:
+                    dxs = dz  # identity shortcut: cout == cin, in-res
+                dx_ref[:] = (dxm + dxs).astype(dx_ref.dtype)
+
+    @pl.when((p == 3) & (i == nt - 1))
+    def _():
+        dw1_ref[:] = dw1_acc[:].astype(dw1_ref.dtype)
+        dw2_ref[:] = dw2_acc[:].reshape(3, 3, pln, pln).astype(dw2_ref.dtype)
+        dw3_ref[:] = dw3_acc[:].astype(dw3_ref.dtype)
+        dg1_ref[:] = s_dp1y[:]
+        db1_ref[:] = s_dp1[:]
+        dg2_ref[:] = s_dp2y[:]
+        db2_ref[:] = s_dp2[:]
+        dg3_ref[:] = s_dzy3[:]
+        db3_ref[:] = s_dz[:]
+        if proj:
+            dws_ref[:] = dws_acc[:].astype(dws_ref.dtype)
+            dgs_ref[:] = s_dzyS[:]
+            dbs_ref[:] = s_dz[:]  # both BN biases add directly into z
+
+
+def _bot_call(x, k1, g1, b1, k2, g2, b2, k3, g3, b3, short,
+              eps, interpret, bn, stride):
+    n, hi, wi, cin = x.shape
+    pln = k1.shape[1]
+    cout = k3.shape[1]
+    ho, wo = hi // stride, wi // stride
+    nt = n // bn
+    proj = short is not None
+    kernel = functools.partial(
+        _bot_fwd_kernel, proj=proj, ho=ho, wo=wo, stride=stride,
+        count1=float(n * hi * wi), count2=float(n * ho * wo), eps=eps,
+    )
+    x_tile = _vmem_spec((bn, hi, wi, cin), lambda p, i: (i, 0, 0, 0))
+    out_tile = _vmem_spec(
+        (bn, ho, wo, cout), lambda p, i: ((p == 3) * i, 0, 0, 0)
+    )
+    k1full = _vmem_spec((cin, pln), lambda p, i: (0, 0))
+    k2full = _vmem_spec((3, 3, pln, pln), lambda p, i: (0, 0, 0, 0))
+    k3full = _vmem_spec((pln, cout), lambda p, i: (0, 0))
+    rowp = _vmem_spec((1, pln), lambda p, i: (0, 0))
+    rowo = _vmem_spec((1, cout), lambda p, i: (0, 0))
+    in_specs = [x_tile, k1full, k2full, k3full]
+    args = [x, k1, k2, k3]
+    if proj:
+        ks, gs, bs = short
+        in_specs.append(_vmem_spec((cin, cout), lambda p, i: (0, 0)))
+        args.append(ks)
+    in_specs += [rowp, rowp, rowp, rowp, rowo, rowo]
+    args += [g1[None, :], b1[None, :], g2[None, :], b2[None, :],
+             g3[None, :], b3[None, :]]
+    if proj:
+        in_specs += [rowo, rowo]
+        args += [gs[None, :], bs[None, :]]
+    out_specs = [out_tile, rowp, rowp, rowp, rowp, rowo, rowo]
+    out_shape = (
+        [jax.ShapeDtypeStruct((n, ho, wo, cout), x.dtype)]
+        + [jax.ShapeDtypeStruct((1, pln), jnp.float32)] * 4
+        + [jax.ShapeDtypeStruct((1, cout), jnp.float32)] * 2
+    )
+    if proj:
+        out_specs += [rowo, rowo]
+        out_shape += [jax.ShapeDtypeStruct((1, cout), jnp.float32)] * 2
+    scratch = (
+        [pltpu.VMEM((bn, hi + 2, wi + 2, pln), x.dtype)]
+        + [pltpu.VMEM((1, pln), jnp.float32) for _ in range(4)]
+        + [pltpu.VMEM((1, cout), jnp.float32) for _ in range(2)]
+        + [pltpu.VMEM((1, pln), jnp.float32) for _ in range(4)]
+        + [pltpu.VMEM((1, cout), jnp.float32) for _ in range(2)]
+    )
+    if proj:
+        scratch += [pltpu.VMEM((1, cout), jnp.float32) for _ in range(4)]
+    return pl.pallas_call(
+        kernel,
+        grid=(4, nt),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*args)
+
+
+def _bot_bwd_call(x, k1, g1, b1, k2, g2, b2, k3, g3, b3, short,
+                  m1, v1, m2, v2, m3, v3, gout, eps, interpret, bn, stride):
+    n, hi, wi, cin = x.shape
+    pln = k1.shape[1]
+    cout = k3.shape[1]
+    ho, wo = hi // stride, wi // stride
+    nt = n // bn
+    proj = short is not None
+    kernel = functools.partial(
+        _bot_bwd_kernel, proj=proj, hi=hi, wi=wi, ho=ho, wo=wo,
+        stride=stride, count1=float(n * hi * wi),
+        count2=float(n * ho * wo), eps=eps,
+    )
+    x_tile = _vmem_spec((bn, hi, wi, cin), lambda p, i: (i, 0, 0, 0))
+    g_tile = _vmem_spec((bn, ho, wo, cout), lambda p, i: (i, 0, 0, 0))
+    dx_tile = _vmem_spec(
+        (bn, hi, wi, cin), lambda p, i: ((p == 3) * i, 0, 0, 0)
+    )
+    k1full = _vmem_spec((cin, pln), lambda p, i: (0, 0))
+    k2full = _vmem_spec((3, 3, pln, pln), lambda p, i: (0, 0, 0, 0))
+    k3full = _vmem_spec((pln, cout), lambda p, i: (0, 0))
+    rowp = _vmem_spec((1, pln), lambda p, i: (0, 0))
+    rowo = _vmem_spec((1, cout), lambda p, i: (0, 0))
+    in_specs = [x_tile, k1full, k2full, k3full, k2full,
+                rowp, rowp, rowp, rowp, rowo, rowo,
+                rowp, rowp, rowp, rowp, rowo, rowo, g_tile]
+    args = [x, k1, k2, k3, _flip_transpose(k2),
+            g1[None, :], b1[None, :], g2[None, :], b2[None, :],
+            g3[None, :], b3[None, :],
+            m1[None, :], v1[None, :], m2[None, :], v2[None, :],
+            m3[None, :], v3[None, :], gout]
+    if proj:
+        ks, gs, bs, mS, vS = short
+        in_specs += [_vmem_spec((cin, cout), lambda p, i: (0, 0)),
+                     rowo, rowo, rowo, rowo]
+        args += [ks, gs[None, :], bs[None, :], mS[None, :], vS[None, :]]
+    out_specs = [dx_tile, k1full, k2full, k3full,
+                 rowp, rowp, rowp, rowp, rowo, rowo]
+    out_shape = [
+        jax.ShapeDtypeStruct((n, hi, wi, cin), x.dtype),
+        jax.ShapeDtypeStruct((cin, pln), k1.dtype),
+        jax.ShapeDtypeStruct((3, 3, pln, pln), k2.dtype),
+        jax.ShapeDtypeStruct((pln, cout), k3.dtype),
+    ] + [jax.ShapeDtypeStruct((1, pln), jnp.float32)] * 4 \
+      + [jax.ShapeDtypeStruct((1, cout), jnp.float32)] * 2
+    if proj:
+        out_specs += [_vmem_spec((cin, cout), lambda p, i: (0, 0)),
+                      rowo, rowo]
+        out_shape += [jax.ShapeDtypeStruct((cin, cout), ks.dtype)] \
+            + [jax.ShapeDtypeStruct((1, cout), jnp.float32)] * 2
+    scratch = [
+        pltpu.VMEM((bn, hi + 2, wi + 2, pln), x.dtype),
+        pltpu.VMEM((bn, hi + 2, wi + 2, pln), x.dtype),
+        pltpu.VMEM((cin, pln), jnp.float32),
+        pltpu.VMEM((9 * pln, pln), jnp.float32),
+        pltpu.VMEM((pln, cout), jnp.float32),
+        pltpu.VMEM((1, cout), jnp.float32),
+        pltpu.VMEM((1, cout), jnp.float32),
+        pltpu.VMEM((1, pln), jnp.float32),
+        pltpu.VMEM((1, pln), jnp.float32),
+        pltpu.VMEM((1, pln), jnp.float32),
+        pltpu.VMEM((1, pln), jnp.float32),
+    ]
+    if proj:
+        scratch += [pltpu.VMEM((cin, cout), jnp.float32),
+                    pltpu.VMEM((1, cout), jnp.float32)]
+    return pl.pallas_call(
+        kernel,
+        grid=(4, nt),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*args)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(10, 11, 12))
+def _bot_id(x, k1, g1, b1, k2, g2, b2, k3, g3, b3, eps, interpret, bn):
+    out, _ = _bot_id_fwd(
+        x, k1, g1, b1, k2, g2, b2, k3, g3, b3, eps, interpret, bn
+    )
+    return out
+
+
+def _bot_id_fwd(x, k1, g1, b1, k2, g2, b2, k3, g3, b3, eps, interpret, bn):
+    out, m1, v1, m2, v2, m3, v3 = _bot_call(
+        x, k1, g1, b1, k2, g2, b2, k3, g3, b3, None, eps, interpret, bn, 1
+    )
+    res = (x, k1, g1, b1, k2, g2, b2, k3, g3, b3,
+           m1[0], v1[0], m2[0], v2[0], m3[0], v3[0])
+    return (out, m1[0], v1[0], m2[0], v2[0], m3[0], v3[0]), res
+
+
+def _bot_id_bwd(eps, interpret, bn, res, ct):
+    (x, k1, g1, b1, k2, g2, b2, k3, g3, b3,
+     m1, v1, m2, v2, m3, v3) = res
+    gout = ct[0]  # batch-moment cotangents discarded (module docstring)
+    dx, dw1, dw2, dw3, dg1, db1, dg2, db2, dg3, db3 = _bot_bwd_call(
+        x, k1, g1, b1, k2, g2, b2, k3, g3, b3, None,
+        m1, v1, m2, v2, m3, v3, gout, eps, interpret, bn, 1,
+    )
+    return (dx, dw1, dg1[0], db1[0], dw2, dg2[0], db2[0],
+            dw3, dg3[0], db3[0])
+
+
+_bot_id.defvjp(_bot_id_fwd, _bot_id_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(13, 14, 15, 16))
+def _bot_proj(x, k1, g1, b1, k2, g2, b2, k3, g3, b3, ks, gs, bs,
+              eps, interpret, bn, stride):
+    out, _ = _bot_proj_fwd(
+        x, k1, g1, b1, k2, g2, b2, k3, g3, b3, ks, gs, bs,
+        eps, interpret, bn, stride,
+    )
+    return out
+
+
+def _bot_proj_fwd(x, k1, g1, b1, k2, g2, b2, k3, g3, b3, ks, gs, bs,
+                  eps, interpret, bn, stride):
+    out, m1, v1, m2, v2, m3, v3, mS, vS = _bot_call(
+        x, k1, g1, b1, k2, g2, b2, k3, g3, b3, (ks, gs, bs),
+        eps, interpret, bn, stride,
+    )
+    res = (x, k1, g1, b1, k2, g2, b2, k3, g3, b3, ks, gs, bs,
+           m1[0], v1[0], m2[0], v2[0], m3[0], v3[0], mS[0], vS[0])
+    return (out, m1[0], v1[0], m2[0], v2[0], m3[0], v3[0],
+            mS[0], vS[0]), res
+
+
+def _bot_proj_bwd(eps, interpret, bn, stride, res, ct):
+    (x, k1, g1, b1, k2, g2, b2, k3, g3, b3, ks, gs, bs,
+     m1, v1, m2, v2, m3, v3, mS, vS) = res
+    gout = ct[0]  # batch-moment cotangents discarded (module docstring)
+    (dx, dw1, dw2, dw3, dg1, db1, dg2, db2, dg3, db3,
+     dws, dgs, dbs) = _bot_bwd_call(
+        x, k1, g1, b1, k2, g2, b2, k3, g3, b3, (ks, gs, bs, mS, vS),
+        m1, v1, m2, v2, m3, v3, gout, eps, interpret, bn, stride,
+    )
+    return (dx, dw1, dg1[0], db1[0], dw2, dg2[0], db2[0],
+            dw3, dg3[0], db3[0], dws, dgs[0], dbs[0])
+
+
+_bot_proj.defvjp(_bot_proj_fwd, _bot_proj_bwd)
+
+
+def fused_bottleneck_block(
+    x: jax.Array,
+    kernel1: jax.Array, scale1: jax.Array, bias1: jax.Array,
+    kernel2: jax.Array, scale2: jax.Array, bias2: jax.Array,
+    kernel3: jax.Array, scale3: jax.Array, bias3: jax.Array,
+    shortcut: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    *, stride: int = 1, eps: float = 1e-5, interpret: bool = False,
+    compute_dtype=None,
+):
+    """Fused rn50-class Bottleneck (1x1 -> 3x3/s -> 1x1, expansion 4),
+    train mode, one kernel each way.
+
+    ``kernel1``/``kernel3`` are the 1x1 convs (``(1,1,cin,planes)`` /
+    ``(1,1,planes,4*planes)`` or already 2-D); ``kernel2`` the 3x3.
+    ``shortcut`` is ``(kernel_sc, scale_sc, bias_sc)`` for projection
+    sites (required exactly when ``stride != 1 or cin != 4*planes``),
+    else None for the identity shortcut. Returns
+    ``(out, m1, v1, m2, v2, m3, v3[, m_sc, v_sc])`` with biased
+    variances; the caller applies the running-stat updates. BN1
+    normalizes at input resolution; BN2/BN3/shortcut-BN at output
+    resolution. Compute dtype follows ``x.dtype`` unless overridden;
+    BN state stays fp32.
+    """
+    n, h, w, cin = x.shape
+    pln = kernel2.shape[2]
+    cdt = _compute_dtype(x, compute_dtype)
+    if not supports_bottleneck(n, h, w, pln, stride=stride,
+                               in_channels=cin, dtype=cdt):
+        raise ValueError(
+            f"fused bottleneck does not admit geometry [{n},{h},{w},{cin}] "
+            f"planes={pln}/s{stride} (supports_bottleneck gate)"
+        )
+    needs_proj = stride != 1 or cin != 4 * pln
+    if needs_proj != (shortcut is not None):
+        raise ValueError(
+            "bottleneck shortcut params must be provided exactly when "
+            "stride != 1 or in_channels != 4*planes"
+        )
+    f32 = jnp.float32
+    args = (
+        x.astype(cdt),
+        kernel1.reshape(cin, pln).astype(cdt),
+        scale1.astype(f32), bias1.astype(f32),
+        kernel2.astype(cdt), scale2.astype(f32), bias2.astype(f32),
+        kernel3.reshape(pln, 4 * pln).astype(cdt),
+        scale3.astype(f32), bias3.astype(f32),
+    )
+    bn = _pick_tile(
+        n,
+        lambda b: _vmem_estimate_bottleneck(
+            b, h, w, cin, pln, stride, needs_proj, cdt.itemsize
+        ) <= VMEM_BUDGET,
+    )
+    if shortcut is None:
+        return _bot_id(*args, float(eps), bool(interpret), bn)
+    ksc, ssc, bsc = shortcut
+    return _bot_proj(
+        *args, ksc.reshape(cin, 4 * pln).astype(cdt),
+        ssc.astype(f32), bsc.astype(f32),
+        float(eps), bool(interpret), bn, int(stride),
     )

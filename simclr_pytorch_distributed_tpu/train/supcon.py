@@ -44,7 +44,7 @@ from simclr_pytorch_distributed_tpu.ops.augment import (
     AugmentConfig,
     two_crop_batch,
 )
-from simclr_pytorch_distributed_tpu.ops import pallas_conv, pallas_loss
+from simclr_pytorch_distributed_tpu.ops import pallas_loss
 from simclr_pytorch_distributed_tpu.ops.metrics import AverageMeter
 from simclr_pytorch_distributed_tpu.ops.schedules import make_lr_schedule
 from simclr_pytorch_distributed_tpu.parallel.mesh import (
@@ -177,43 +177,21 @@ def resolve_loss_impl(
 
 
 def conv_fused_sites(
-    model: str, rows: int, size: int
+    model: str, rows: int, size: int, dtype=jnp.float32
 ) -> List[str]:
     """The encoder sites ``--conv_impl pallas`` would fuse at this
-    geometry: walks the model's stage structure against the
-    ops/pallas_conv ``supports_*`` gates. ``rows`` is the encoder's
-    view-major batch (``2*batch_size`` for the two-crop step). Bottleneck
-    models admit the stem only (the 1x1-3x3-1x1 chain is the recorded
-    open edge, docs/PERF.md round 15)."""
-    from simclr_pytorch_distributed_tpu.models.resnet import BasicBlock
+    geometry and compute dtype: the admitted subset of
+    ``models.resnet.fused_site_plan`` — the single-sourced walk the block
+    modules' own gates mirror, so banner and runtime dispatch can never
+    disagree. ``rows`` is the encoder's view-major batch (``2*batch_size``
+    for the two-crop step)."""
+    from simclr_pytorch_distributed_tpu.models.resnet import fused_site_plan
 
-    ctor, _ = MODEL_DICT[model]
-    mod = ctor()
-    sites: List[str] = []
-    h = w = size
-    if pallas_conv.supports_stem(rows, h, w, 3, 64):
-        sites.append(f"stem 3->64@{h}x{w}")
-    if mod.block_cls is not BasicBlock:
-        return sites
-    widths = (64, 128, 256, 512)
-    stage_strides = (1, 2, 2, 2)
-    in_c = 64
-    for stage, (n_blocks, width, stage_stride) in enumerate(
-        zip(mod.stage_sizes, widths, stage_strides)
-    ):
-        for block in range(n_blocks):
-            stride = stage_stride if block == 0 else 1
-            if stride != 1:
-                # stride-2 conv with (1,1) padding: out = ceil(h/2) — the
-                # model's own gates see this exact shape at odd sizes
-                h = (h + 1) // 2
-                w = (w + 1) // 2
-            elif in_c == width and pallas_conv.supports_block(
-                rows, h, w, width, stride=stride, in_channels=in_c
-            ):
-                sites.append(f"layer{stage + 1}_block{block} {width}@{h}x{w}")
-            in_c = width
-    return sites
+    return [
+        site["desc"]
+        for site in fused_site_plan(model, rows, size, dtype=dtype)
+        if site["admitted"]
+    ]
 
 
 def resolve_conv_impl(
@@ -224,26 +202,24 @@ def resolve_conv_impl(
     ``resolve_loss_impl`` ladder convention applied to the encoder's conv
     path (ops/pallas_conv.py).
 
-    'auto' picks the fused Pallas stem/BasicBlock kernels only on a
-    single-device TPU mesh, fp32, at geometries the per-site
-    ``supports_*`` gates admit (the model applies them site by site; the
-    reason names the admitted sites). Explicit 'pallas' is honored on any
-    backend (interpret mode off-TPU — tests and the checkpoint
-    round-trip smoke, not throughput) but raises loudly where it could
-    only be a silent no-op (multi-device mesh, zero admitted sites) —
-    the placement ladder's honored-or-raise rule.
+    'auto' picks the fused Pallas stem/BasicBlock/Bottleneck kernels only
+    on a single-device TPU mesh, fp32 OR bf16 compute, at geometries the
+    per-site ``supports_*`` gates admit (the model applies them site by
+    site; the reason names the admitted sites and the compute dtype).
+    Explicit 'pallas' is honored on any backend (interpret mode off-TPU —
+    tests and the checkpoint round-trip smoke, not throughput), with
+    ``--bf16`` admitted site-by-site exactly like fp32 (the kernels carry
+    bf16 variants with fp32 accumulation; config.validate_conv_impl no
+    longer rejects the pairing at parse), but raises loudly where it
+    could only be a silent no-op (multi-device mesh, zero admitted
+    sites) — the placement ladder's honored-or-raise rule.
     """
     if conv_impl == "xla":
         return "xla", "explicit request: bitwise-pinned XLA conv path"
     rows = 2 * batch_size
+    dtype = jnp.bfloat16 if bf16 else jnp.float32
+    dtype_tag = "bf16" if bf16 else "fp32"
     if conv_impl == "pallas":
-        if bf16:
-            # parse-time validate_conv_impl rejects the CLI spelling; this
-            # guards programmatic callers (bench, tests) identically
-            raise ValueError(
-                "--conv_impl pallas requires fp32 compute (fused kernels "
-                "implement fp32 whole-batch BN) — drop --bf16 or use auto"
-            )
         if n_devices > 1:
             raise ValueError(
                 f"--conv_impl pallas requires a single-device mesh, got "
@@ -252,13 +228,13 @@ def resolve_conv_impl(
                 "groups / GSPMD partitioning of the pallas_call are the "
                 "recorded open edge, docs/PERF.md round 15)"
             )
-        sites = conv_fused_sites(model, rows, size)
+        sites = conv_fused_sites(model, rows, size, dtype=dtype)
         if not sites:
             raise ValueError(
                 f"--conv_impl pallas admits no site for {model} at "
-                f"[{rows},{size},{size}] (fp32 identity-shortcut "
-                "BasicBlocks + stem only; see ops/pallas_conv.supports_*) "
-                "— use auto, which degrades to xla with a banner"
+                f"[{rows},{size},{size}] {dtype_tag} (see "
+                "ops/pallas_conv.supports_*) — use auto, which degrades "
+                "to xla with a banner"
             )
         backend = jax.default_backend()
         mode = (
@@ -266,7 +242,8 @@ def resolve_conv_impl(
             else f"INTERPRET mode on {backend} (correctness only, slow)"
         )
         return "pallas", (
-            f"explicit request, {mode}; fused sites: {', '.join(sites)}"
+            f"explicit request, {mode}, compute dtype {dtype_tag}; "
+            f"fused sites: {', '.join(sites)}"
         )
     # auto
     if jax.default_backend() != "tpu":
@@ -279,15 +256,16 @@ def resolve_conv_impl(
             f"multi-device mesh ({n_devices}): fused kernels are "
             "single-chip (whole-batch BN inside one program)"
         )
-    if bf16:
-        return "xla", "--bf16: fused kernels are fp32-only"
-    sites = conv_fused_sites(model, rows, size)
+    sites = conv_fused_sites(model, rows, size, dtype=dtype)
     if not sites:
         return "xla", (
             f"no admitted geometry for {model} at [{rows},{size},{size}] "
-            "(ops/pallas_conv.supports_*)"
+            f"{dtype_tag} (ops/pallas_conv.supports_*)"
         )
-    return "pallas", f"TPU single-chip, fused sites: {', '.join(sites)}"
+    return "pallas", (
+        f"TPU single-chip, compute dtype {dtype_tag}, "
+        f"fused sites: {', '.join(sites)}"
+    )
 
 
 def build(cfg: config_lib.SupConConfig, steps_per_epoch: int, n_devices: int = 1):

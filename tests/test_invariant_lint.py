@@ -458,10 +458,11 @@ def test_ratchet_default_list_includes_lint_gate():
 def test_committed_evidence_passes_gate():
     """The committed docs/evidence artifact re-verifies under the pure
     gate record — the acceptance-criteria bind."""
-    # r18: regenerated after serve/fleet/ivf.py and
-    # scripts/retrieval_ab.py joined the scanned surface (101 files; the
-    # IVF retrieval round)
-    path = os.path.join(REPO, "docs", "evidence", "invariant_lint_r18.json")
+    # r19: regenerated after the fused-conv ladder round (bf16 kernels,
+    # projection/Bottleneck blocks) reshaped ops/pallas_conv.py,
+    # models/resnet.py, and scripts/convblock_ab.py in place (101 files —
+    # no new files joined the surface, the scanned set's contents moved)
+    path = os.path.join(REPO, "docs", "evidence", "invariant_lint_r19.json")
     with open(path) as f:
         artifact = json.load(f)
     ratchet = _ratchet()
